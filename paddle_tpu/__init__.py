@@ -1,0 +1,50 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities of
+Fluid-era PaddlePaddle (reference: coslian/Paddle v0.14.0).
+
+Architecture (see SURVEY.md for the reference blueprint):
+  - Program/Block/Op IR built from a layers DSL (core/ir.py)
+  - ops are JAX lowering rules; shape inference via eval_shape (core/registry.py)
+  - program-level autodiff emitting generic vjp grad ops (core/backward.py)
+  - Executor compiles whole blocks into single XLA computations (core/executor.py)
+  - data parallelism via pjit/GSPMD over a device Mesh (parallel/)
+"""
+
+from .core import ir as _ir
+from .core.ir import (Program, program_guard, default_main_program,  # noqa: F401
+                      default_startup_program, Variable, Parameter)
+from .core.executor import (Executor, Scope, global_scope,  # noqa: F401
+                            CPUPlace, TPUPlace, CUDAPlace)
+from .core.backward import append_backward, calc_gradient  # noqa: F401
+
+from . import ops  # noqa: F401  (registers all lowering rules)
+from . import layers  # noqa: F401
+from . import initializer  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import clip  # noqa: F401
+from . import unique_name  # noqa: F401
+from . import nets  # noqa: F401
+from . import metrics  # noqa: F401
+from . import io  # noqa: F401
+from . import profiler  # noqa: F401
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from .data_feeder import DataFeeder  # noqa: F401
+from .parallel.parallel_executor import (ParallelExecutor,  # noqa: F401
+                                         BuildStrategy, ExecutionStrategy)
+from . import backward  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    import jax
+    return any(d.platform != "cpu" for d in jax.devices())
+
+
+def tpu_device_count() -> int:
+    import jax
+    return len(jax.devices())

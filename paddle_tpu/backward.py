@@ -1,0 +1,3 @@
+"""Re-export of program-level autodiff (reference: fluid.backward)."""
+
+from .core.backward import append_backward, calc_gradient  # noqa: F401

@@ -1,0 +1,219 @@
+"""Block -> XLA lowering.
+
+This replaces the reference's executor hot loop (`for op in ops: op->Run(...)`,
+reference: paddle/fluid/framework/executor.cc:321-366) and its per-op kernel
+dispatch (operator.cc:635). TPU-native redesign: the whole block is traced
+once through each op's JAX lowering rule into ONE jit-compiled XLA
+computation; XLA then fuses/schedules what the reference interpreted op by op.
+
+Gradient ops (produced by core/backward.py) are lowered generically: the
+forward rule is re-traced under `jax.vjp`. Duplicate forward subexpressions
+are eliminated by XLA CSE inside the single jit, so no residual plumbing is
+required in the IR.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Set
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import ir, registry, types
+from .ir import SEQLEN_SUFFIX
+from .registry import EMPTY_VAR, FWD_OP_ATTR, GRAD_OP_SUFFIX, LoweringContext
+
+
+class BlockLowerer:
+    """Lowers a Block's op list into a pure function over an env dict."""
+
+    def __init__(self, program: ir.Program):
+        self.program = program
+
+    def run_block(self, block_idx: int, env: Dict[str, Any], key) -> Dict[str, Any]:
+        """Execute all ops of `block_idx` on `env` (name -> jnp array),
+        mutating and returning it. `key` is the step's base PRNG key."""
+        block = self.program.blocks[block_idx]
+        for op_idx, op in enumerate(block.ops):
+            self._run_op(block, op, op_idx, env, key)
+        return env
+
+    # -- single op -------------------------------------------------------
+    def _run_op(self, block: ir.Block, op: ir.Operator, op_idx: int,
+                env: Dict[str, Any], key):
+        if op.type.endswith(GRAD_OP_SUFFIX) and FWD_OP_ATTR in op.attrs:
+            self._run_grad_op(block, op, env, key)
+            return
+        opdef = registry.get_op_def(op.type)
+        op_key = jax.random.fold_in(key, _op_seed(op, op_idx)) if opdef.needs_rng else None
+        ins = _gather_inputs(op.inputs, env, op.type)
+        ctx = LoweringContext(op.attrs, key=op_key, lowerer=self, op=op)
+        outs = registry.call_rule(opdef, ctx, ins)
+        _scatter_outputs(op, outs, env)
+        if opdef.propagate_seqlen:
+            _propagate_seqlen(op, env)
+
+    # -- generic vjp-based grad op --------------------------------------
+    def _run_grad_op(self, block: ir.Block, op: ir.Operator,
+                     env: Dict[str, Any], key):
+        fwd = op.attrs[FWD_OP_ATTR]          # forward OpDesc as dict
+        fwd_type, fwd_inputs, fwd_outputs = fwd["type"], fwd["inputs"], fwd["outputs"]
+        fwd_attrs, fwd_idx = fwd["attrs"], fwd.get("__idx__", 0)
+        opdef = registry.get_op_def(fwd_type)
+        op_key = jax.random.fold_in(key, fwd_idx) if opdef.needs_rng else None
+
+        if opdef.grad_lower is not None:
+            ins = {s: [env[n] for n in ns] for s, ns in fwd_inputs.items()}
+            out_grads = {}
+            for slot, names in fwd_outputs.items():
+                out_grads[slot] = [env.get(ir.grad_var_name(n)) for n in names]
+            ctx = LoweringContext(fwd_attrs, key=op_key, lowerer=self, op=op)
+            grads = opdef.grad_lower(ctx, ins, out_grads)
+            _write_input_grads(op, fwd_inputs, grads, env)
+            return
+
+        # Flatten differentiable fwd inputs; keep the rest closed over.
+        diff_entries: List[tuple] = []   # (slot, pos, name)
+        for slot, names in fwd_inputs.items():
+            for pos, name in enumerate(names):
+                val = env[name]
+                if jnp.issubdtype(jnp.asarray(val).dtype, jnp.floating):
+                    diff_entries.append((slot, pos, name))
+        wanted = _wanted_input_grads(op)
+        diff_entries = [e for e in diff_entries if e[2] in wanted]
+        if not diff_entries:
+            return
+        diff_vals = [env[name] for _, _, name in diff_entries]
+
+        out_slots = [(slot, names) for slot, names in fwd_outputs.items() if names]
+
+        def fwd_fn(*vals):
+            ins = {s: [env[n] for n in ns] for s, ns in fwd_inputs.items()}
+            for (slot, pos, _), v in zip(diff_entries, vals):
+                ins[slot][pos] = v
+            ctx = LoweringContext(fwd_attrs, key=op_key, lowerer=self)
+            outs = registry.call_rule(opdef, ctx, ins)
+            flat = []
+            for slot, names in out_slots:
+                flat.extend(outs[slot][: len(names)])
+            return tuple(flat)
+
+        declared_by_base = _declared_by_base(op)
+        primals, vjp_fn = jax.vjp(fwd_fn, *diff_vals)
+        cotangents = []
+        i = 0
+        for slot, names in out_slots:
+            for name in names:
+                primal = primals[i]
+                i += 1
+                g = env.get(ir.grad_var_name(name))
+                if g is None:
+                    g = _zero_cotangent(primal)
+                elif jnp.issubdtype(jnp.asarray(primal).dtype, jnp.floating):
+                    g = jnp.asarray(g, jnp.asarray(primal).dtype)
+                else:
+                    g = _zero_cotangent(primal)
+                cotangents.append(g)
+        in_grads = vjp_fn(tuple(cotangents))
+
+        # Accumulate per-variable (a var may appear in several input slots).
+        acc: Dict[str, Any] = {}
+        for (slot, pos, name), g in zip(diff_entries, in_grads):
+            if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
+                continue
+            acc[name] = g if name not in acc else acc[name] + g
+        for name, g in acc.items():
+            if name in declared_by_base:
+                env[declared_by_base[name]] = g
+
+
+def _op_seed(op: ir.Operator, op_idx: int) -> int:
+    return int(op.attrs.get("__idx__", op_idx))
+
+
+def _gather_inputs(inputs: Dict[str, List[str]], env: Dict[str, Any], op_type: str):
+    ins = {}
+    for slot, names in inputs.items():
+        vals = []
+        for n in names:
+            if n == EMPTY_VAR:
+                vals.append(None)
+                continue
+            if n not in env:
+                raise KeyError(f"op {op_type}: input var {n!r} not materialized")
+            vals.append(env[n])
+        ins[slot] = vals
+    return ins
+
+
+def _scatter_outputs(op: ir.Operator, outs: Dict[str, List[Any]], env: Dict[str, Any]):
+    for slot, names in op.outputs.items():
+        if slot not in outs:
+            continue
+        vals = outs[slot]
+        if len(vals) < len(names):
+            raise ValueError(f"op {op.type}: slot {slot} produced {len(vals)} values "
+                             f"for {len(names)} outputs")
+        for name, val in zip(names, vals):
+            if name != EMPTY_VAR and val is not None:
+                env[name] = val
+
+
+def _propagate_seqlen(op: ir.Operator, env: Dict[str, Any]):
+    """Variable-length (LoD-analog) bookkeeping: elementwise-ish ops carry the
+    first input's @SEQLEN companion onto their outputs."""
+    src = None
+    for names in op.inputs.values():
+        for n in names:
+            if n != EMPTY_VAR and (n + SEQLEN_SUFFIX) in env:
+                src = env[n + SEQLEN_SUFFIX]
+                break
+        if src is not None:
+            break
+    if src is None:
+        return
+    for names in op.outputs.values():
+        for n in names:
+            if n != EMPTY_VAR and n in env and (n + SEQLEN_SUFFIX) not in env:
+                val = env[n]
+                if hasattr(val, "ndim") and val.ndim >= 2 and val.shape[0] == src.shape[0]:
+                    env[n + SEQLEN_SUFFIX] = src
+
+
+def _grad_base(grad_name: str) -> str:
+    """`x@GRAD` or `x@GRAD@RENAME@k` -> `x` (fan-in contributions are renamed
+    by core/backward.py before a `sum` op re-merges them)."""
+    return grad_name.split(ir.GRAD_SUFFIX)[0]
+
+
+def _declared_by_base(grad_op: ir.Operator) -> Dict[str, str]:
+    out = {}
+    for names in grad_op.outputs.values():
+        for n in names:
+            if n != EMPTY_VAR and ir.GRAD_SUFFIX in n:
+                out[_grad_base(n)] = n
+    return out
+
+
+def _wanted_input_grads(grad_op: ir.Operator) -> Set[str]:
+    return set(_declared_by_base(grad_op))
+
+
+def _write_input_grads(grad_op, fwd_inputs, grads: Dict[str, Any], env):
+    declared = _declared_by_base(grad_op)
+    for slot, g in grads.items():
+        names = fwd_inputs.get(slot, [])
+        gs = g if isinstance(g, (list, tuple)) else [g]
+        for name, gv in zip(names, gs):
+            if gv is None or name not in declared:
+                continue
+            gname = declared[name]
+            env[gname] = gv if gname not in env else env[gname] + gv
+
+
+def _zero_cotangent(primal):
+    arr = jnp.asarray(primal)
+    if jnp.issubdtype(arr.dtype, jnp.floating):
+        return jnp.zeros(arr.shape, arr.dtype)
+    return np.zeros(arr.shape, jax.dtypes.float0)

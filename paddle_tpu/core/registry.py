@@ -1,0 +1,193 @@
+"""Op registry: each op type maps to a JAX lowering rule.
+
+Capability parity with the reference's operator registry + kernel dispatch
+(reference: paddle/fluid/framework/op_registry.h:185-217, op_info.h:68,
+operator.cc:635-830). TPU-native redesign: an "op kernel" is a pure JAX
+function (the *lowering rule*); whole blocks are traced through these rules
+into a single XLA computation, so there is no per-op dispatch at runtime, no
+OpKernelType keying, and no data-transform insertion — XLA owns layout/fusion.
+
+Shape inference (reference shape_inference.h:30) is derived from the lowering
+rule itself via `jax.eval_shape`: the rule is the single source of truth for
+both compile-time shapes and runtime values.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import types
+
+# Sentinel size substituted for -1 (unknown batch) dims during build-time shape
+# inference. Prime and large, so it never collides with a real feature dim.
+DIM_SENTINEL = 8191
+
+EMPTY_VAR = "@EMPTY@"
+GRAD_OP_SUFFIX = "_grad"
+FWD_OP_ATTR = "__fwd_op__"  # grad ops carry the forward OpDesc dict here
+
+
+class OpDef:
+    def __init__(self, type: str, lower: Callable, infer: Optional[Callable],
+                 needs_rng: bool, propagate_seqlen: bool,
+                 grad_lower: Optional[Callable] = None):
+        self.type = type
+        self.lower = lower
+        self.infer = infer
+        self.needs_rng = needs_rng
+        self.propagate_seqlen = propagate_seqlen
+        self.grad_lower = grad_lower
+        # parameter names of the rule (minus ctx) = input slot names
+        sig = inspect.signature(lower)
+        params = list(sig.parameters.values())[1:]
+        self.input_slots = [p.name for p in params]
+        self.optional_slots = {p.name for p in params if p.default is not inspect.Parameter.empty}
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_op(type: str, infer: Optional[Callable] = None, needs_rng: bool = False,
+                propagate_seqlen: bool = True):
+    """Decorator registering a lowering rule for op `type`.
+
+    The rule's signature is ``rule(ctx, SlotA, SlotB=None, ...)`` where slot
+    parameter names match the OpDesc input slots; each receives a jnp array
+    (or a list when the slot holds multiple vars, e.g. `sum`'s X). It returns
+    ``{output_slot: array_or_list}``.
+    """
+
+    def deco(fn):
+        if type in _REGISTRY:
+            raise ValueError(f"op {type!r} already registered")
+        _REGISTRY[type] = OpDef(type, fn, infer, needs_rng, propagate_seqlen)
+        return fn
+
+    return deco
+
+
+def register_grad(type: str):
+    """Optionally register a hand-written grad lowering for op `type`
+    (overrides the generic vjp-based grad). Signature:
+    ``grad(ctx, ins: dict, out_grads: dict) -> dict[input_slot, grad]``."""
+
+    def deco(fn):
+        _REGISTRY[type].grad_lower = fn
+        return fn
+
+    return deco
+
+
+def get_op_def(type: str) -> OpDef:
+    if type not in _REGISTRY:
+        raise KeyError(f"op type {type!r} is not registered")
+    return _REGISTRY[type]
+
+
+def is_registered(type: str) -> bool:
+    return type in _REGISTRY
+
+
+def registered_ops() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+class LoweringContext:
+    """Per-op context handed to lowering rules.
+
+    attrs: the OpDesc attrs; key: a PRNG key unique to (step, op position) for
+    random ops, threaded functionally through the compiled step (replacing the
+    reference's per-op cuRAND states).
+    """
+
+    def __init__(self, attrs: Dict[str, Any], key=None, lowerer=None, op=None):
+        self.attrs = attrs
+        self.key = key
+        self.lowerer = lowerer   # BlockLowerer, for control-flow sub-blocks
+        self.op = op
+
+    def attr(self, name: str, default=None):
+        return self.attrs.get(name, default)
+
+
+def call_rule(opdef: OpDef, ctx: LoweringContext, ins_by_slot: Dict[str, List[Any]]):
+    """Dispatch arrays to the rule per its signature; normalize outputs."""
+    kwargs = {}
+    for slot in opdef.input_slots:
+        vals = ins_by_slot.get(slot)
+        if vals is None or len(vals) == 0:
+            if slot not in opdef.optional_slots:
+                raise ValueError(f"op {opdef.type}: required input slot {slot!r} missing")
+            continue
+        kwargs[slot] = vals[0] if len(vals) == 1 else list(vals)
+    out = opdef.lower(ctx, **kwargs)
+    if out is None:
+        out = {}
+    norm = {}
+    for slot, v in out.items():
+        norm[slot] = list(v) if isinstance(v, (list, tuple)) else [v]
+    return norm
+
+
+# ---------------------------------------------------------------------------
+# Build-time shape inference via eval_shape (reference: InferShape contexts).
+# ---------------------------------------------------------------------------
+
+def _mark_dynamic(shape, had_dynamic_input: bool):
+    out = []
+    for d in shape:
+        d = int(d)
+        # A dim equal to (or a multiple of) the sentinel derives from the
+        # dynamic batch dim -> record as unknown. Mixed sums like SENTINEL+k
+        # (rare: concat of dynamic with static) stay as-is; runtime shapes
+        # remain authoritative.
+        if had_dynamic_input and d >= DIM_SENTINEL and d % DIM_SENTINEL == 0:
+            out.append(-1)
+        else:
+            out.append(d)
+    return tuple(out)
+
+
+def infer_op_shapes(op_type: str, attrs: Dict[str, Any],
+                    ins_by_slot: Dict[str, List[Any]]):
+    """Return {output_slot: [(shape, dtype), ...]} for an op given input
+    (shape, dtype) pairs. -1 dims are substituted with DIM_SENTINEL, traced
+    through the lowering rule abstractly, and mapped back to -1."""
+    opdef = get_op_def(op_type)
+    had_dynamic = False
+    structs: Dict[str, List[jax.ShapeDtypeStruct]] = {}
+    for slot, pairs in ins_by_slot.items():
+        ss = []
+        for shape, dtype in pairs:
+            shp = []
+            for d in shape:
+                if d == -1:
+                    had_dynamic = True
+                    shp.append(DIM_SENTINEL)
+                else:
+                    shp.append(int(d))
+            ss.append(jax.ShapeDtypeStruct(tuple(shp), types.np_dtype(dtype)))
+        structs[slot] = ss
+
+    if opdef.infer is not None:
+        result = opdef.infer(LoweringContext(attrs), structs)
+    else:
+        key = jax.random.key(0)
+
+        def f(ins):
+            ctx = LoweringContext(attrs, key=key)
+            return call_rule(opdef, ctx, ins)
+
+        result = jax.eval_shape(f, structs)
+
+    out = {}
+    for slot, vals in result.items():
+        vals = vals if isinstance(vals, (list, tuple)) else [vals]
+        out[slot] = [(_mark_dynamic(v.shape, had_dynamic), types.canonical_dtype(v.dtype))
+                     for v in vals]
+    return out

@@ -1,0 +1,78 @@
+"""Core type vocabulary for the program IR.
+
+Capability parity with the reference's ``VarType`` proto enum
+(reference: paddle/fluid/framework/framework.proto:97-160) and its dtype table.
+TPU-native redesign: dtypes are plain strings mapping 1:1 onto jnp dtypes; the
+variable kinds collapse to what a functional XLA runtime actually needs.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class VarKind(enum.Enum):
+    # Dense tensor, optionally carrying sequence lengths (the LoDTensor analog:
+    # reference lod_tensor.h:110 — we use padded dense + per-row lengths).
+    DENSE_TENSOR = "dense_tensor"
+    # Sparse row-slice tensor (reference selected_rows.h:30): (rows, values).
+    SELECTED_ROWS = "selected_rows"
+    # Array of tensors (reference lod_tensor_array.h) for control-flow plumbing.
+    TENSOR_ARRAY = "tensor_array"
+    # Data-source handle (reference reader.h:28).
+    READER = "reader"
+    # Scope(s) kept by control-flow ops (reference recurrent_op.cc StepScopes).
+    STEP_SCOPES = "step_scopes"
+    RAW = "raw"
+
+
+# Canonical dtype strings -> numpy/jnp dtypes.
+_DTYPES = {
+    "bool": np.bool_,
+    "int8": np.int8,
+    "uint8": np.uint8,
+    "int16": np.int16,
+    "int32": np.int32,
+    "int64": np.int64,
+    "float16": np.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": np.float32,
+    "float64": np.float64,
+}
+
+_ALIASES = {
+    "fp16": "float16",
+    "fp32": "float32",
+    "fp64": "float64",
+    "bf16": "bfloat16",
+    "half": "float16",
+    "float": "float32",
+    "double": "float64",
+    "int": "int32",
+    "long": "int64",
+}
+
+FLOAT_DTYPES = ("float16", "bfloat16", "float32", "float64")
+
+
+def canonical_dtype(dtype) -> str:
+    """Normalize a user-supplied dtype (str / np.dtype / jnp type) to a canonical string."""
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+    else:
+        name = jnp.dtype(dtype).name
+        name = _ALIASES.get(name, name)
+    if name not in _DTYPES:
+        raise ValueError(f"unsupported dtype: {dtype!r}")
+    return name
+
+
+def np_dtype(dtype) -> np.dtype:
+    return jnp.dtype(_DTYPES[canonical_dtype(dtype)])
+
+
+def is_float_dtype(dtype) -> bool:
+    return canonical_dtype(dtype) in FLOAT_DTYPES

@@ -1,0 +1,61 @@
+"""DataFeeder: python rows -> padded device-ready feed dicts.
+
+Capability parity with reference python/paddle/fluid/data_feeder.py:81
+(`DataFeeder.feed` builds LoDTensors from nested lists). TPU-native: LoD
+sequences become (padded dense array, lengths) pairs which the executor feeds
+as `name` + `name@SEQLEN`.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from .core import ir, types
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_vars = []
+        program = program or ir.default_main_program()
+        for v in feed_list:
+            if isinstance(v, str):
+                v = program.global_block().var(v)
+            self.feed_vars.append(v)
+        self.place = place
+
+    def feed(self, iterable, pad_to: int = 0):
+        """`iterable` is a batch: list of rows, each row a tuple with one
+        entry per feed var. Returns {name: array | (array, lengths)}."""
+        rows = list(iterable)
+        out = {}
+        for i, var in enumerate(self.feed_vars):
+            col = [row[i] for row in rows]
+            dtype = types.np_dtype(var.dtype)
+            if var.lod_level == 0:
+                arr = np.asarray(col, dtype=dtype)
+                shape = [d for d in var.shape if d != -1]
+                if arr.ndim == 1 and len(shape) > 0 and int(np.prod(shape)) > 1:
+                    arr = arr.reshape([len(rows)] + shape)
+                elif arr.ndim == len(shape):  # missing batch dim broadcuing
+                    pass
+                # classification labels: [N] -> [N, 1] when var declared 2-D
+                if arr.ndim == 1 and len(var.shape) == 2 and var.shape[-1] == 1:
+                    arr = arr.reshape(-1, 1)
+                out[var.name] = arr
+            else:
+                lens = np.array([len(s) for s in col], np.int32)
+                maxlen = max(int(lens.max()), 1)
+                if pad_to:
+                    maxlen = max(maxlen, pad_to)
+                first = np.asarray(col[0], dtype=dtype)
+                feat = list(first.shape[1:])
+                padded = np.zeros([len(col), maxlen] + feat, dtype=dtype)
+                for b, seq in enumerate(col):
+                    s = np.asarray(seq, dtype=dtype)
+                    if s.ndim == 1 and len(var.shape) >= 3 and var.shape[-1] == 1:
+                        s = s.reshape(-1, 1)
+                    padded[b, : len(seq)] = s
+                out[var.name] = (padded, lens)
+        return out

@@ -1,0 +1,143 @@
+"""Model / variable save-load.
+
+Capability parity with reference python/paddle/fluid/io.py:
+save_vars/save_params/save_persistables (:86-290), load_vars/load_params/
+load_persistables (:292-455), save_inference_model (:551),
+load_inference_model (:654). The reference serializes per-variable
+LoDTensor streams via save/load ops; the TPU-native design serializes the
+scope arrays to one .npz per save (or one file per var with
+`filename=None`-style layout preserved) and the Program to JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .core import ir
+from .core.executor import Executor, Scope, global_scope
+
+MODEL_FILENAME = "__model__"
+PARAMS_SUFFIX = ".npy"
+
+
+def _is_persistable(var: ir.Variable) -> bool:
+    return var.persistable and not var.is_data and var.kind == ir.VarKind.DENSE_TENSOR
+
+
+def _is_parameter(var: ir.Variable) -> bool:
+    return isinstance(var, ir.Parameter)
+
+
+def _collect(program: ir.Program, predicate) -> List[ir.Variable]:
+    return [v for v in program.global_block().vars.values() if predicate(v)]
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None, scope=None):
+    """reference io.py:86 save_vars."""
+    main_program = main_program or ir.default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        vars = _collect(main_program, predicate or _is_persistable)
+    os.makedirs(dirname, exist_ok=True)
+    if filename is not None:
+        blob = {}
+        for v in vars:
+            arr = scope.find_var(v.name)
+            if arr is None:
+                raise RuntimeError(f"variable {v.name} not in scope")
+            blob[v.name] = np.asarray(arr)
+        np.savez(os.path.join(dirname, filename), **blob)
+    else:
+        for v in vars:
+            arr = scope.find_var(v.name)
+            if arr is None:
+                raise RuntimeError(f"variable {v.name} not in scope")
+            np.save(os.path.join(dirname, v.name + PARAMS_SUFFIX),
+                    np.asarray(arr))
+
+
+def save_params(executor, dirname, main_program=None, filename=None, scope=None):
+    return save_vars(executor, dirname, main_program, None, _is_parameter,
+                     filename, scope)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
+    return save_vars(executor, dirname, main_program, None, _is_persistable,
+                     filename, scope)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None, scope=None):
+    """reference io.py:292 load_vars."""
+    main_program = main_program or ir.default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        vars = _collect(main_program, predicate or _is_persistable)
+    if filename is not None:
+        if not filename.endswith(".npz"):
+            filename = filename + ".npz"  # np.savez appended it on save
+        blob = np.load(os.path.join(dirname, filename))
+        for v in vars:
+            scope.set_var(v.name, np.asarray(blob[v.name]))
+    else:
+        for v in vars:
+            path = os.path.join(dirname, v.name + PARAMS_SUFFIX)
+            if not os.path.exists(path):
+                raise RuntimeError(f"no saved file for variable {v.name} at {path}")
+            scope.set_var(v.name, np.load(path))
+
+
+def load_params(executor, dirname, main_program=None, filename=None, scope=None):
+    return load_vars(executor, dirname, main_program, None, _is_parameter,
+                     filename, scope)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
+    return load_vars(executor, dirname, main_program, None, _is_persistable,
+                     filename, scope)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, scope=None):
+    """Prune to the inference slice and persist program+params
+    (reference io.py:551)."""
+    main_program = main_program or ir.default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    target_names = [v.name if isinstance(v, ir.Variable) else str(v)
+                    for v in target_vars]
+    pruned = main_program.clone(for_test=True)._prune(target_names)
+    meta = {
+        "program": pruned.to_dict(),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": target_names,
+    }
+    with open(os.path.join(dirname, model_filename or MODEL_FILENAME), "w") as f:
+        json.dump(meta, f)
+    save_persistables(executor, dirname, pruned, params_filename, scope)
+    return target_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None, scope=None):
+    """reference io.py:654 — returns (program, feed_names, fetch_vars)."""
+    with open(os.path.join(dirname, model_filename or MODEL_FILENAME)) as f:
+        meta = json.load(f)
+    program = ir.Program.from_dict(meta["program"])
+    program._is_inference = True
+    load_persistables(executor, dirname, program, params_filename, scope)
+    fetch_vars = [program.global_block().var(n) for n in meta["fetch_names"]]
+    return program, meta["feed_names"], fetch_vars
+
+
+def get_inference_program(target_vars, main_program=None):
+    main_program = main_program or ir.default_main_program()
+    names = [v.name if isinstance(v, ir.Variable) else str(v) for v in target_vars]
+    return main_program.clone(for_test=True)._prune(names)

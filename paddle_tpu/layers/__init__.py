@@ -1,0 +1,13 @@
+"""Layers DSL (reference: python/paddle/fluid/layers/)."""
+
+from .io import data  # noqa: F401
+from .nn import *  # noqa: F401,F403
+from .tensor import (create_tensor, create_global_var, fill_constant,  # noqa: F401
+                     fill_constant_batch_size_like, assign, cast, concat, sums,
+                     argmax, argmin, zeros, ones, reverse)
+from .ops import *  # noqa: F401,F403
+from .metric_op import accuracy, auc  # noqa: F401
+from . import learning_rate_scheduler  # noqa: F401
+from .math_op_patch import monkey_patch_variable
+
+monkey_patch_variable()

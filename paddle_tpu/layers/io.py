@@ -1,0 +1,30 @@
+"""Data-input layers (reference: python/paddle/fluid/layers/io.py)."""
+
+from __future__ import annotations
+
+from ..core import ir
+from ..layer_helper import LayerHelper
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
+         stop_gradient=True):
+    """Declare an input variable (reference io.py:35 `data`).
+
+    With append_batch_size (default, as in the reference) a -1 batch dim is
+    prepended. lod_level>0 declares a variable-length sequence input: feed a
+    `(padded_array, lengths)` pair or let DataFeeder build it.
+    """
+    helper = LayerHelper("data", name=name)
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    block = helper.main_program.current_block()
+    if name in block.vars:
+        v = block.vars[name]
+    else:
+        v = block.create_var(name=name, shape=shape, dtype=dtype,
+                             lod_level=lod_level, stop_gradient=stop_gradient,
+                             is_data=True)
+    if lod_level > 0:
+        helper.ensure_seqlen_var(v)
+    return v

@@ -1,0 +1,121 @@
+"""Learning-rate schedules as in-graph ops (reference:
+python/paddle/fluid/layers/learning_rate_scheduler.py).
+
+Each schedule builds a tiny subgraph reading a persistable global step counter
+(incremented once per optimizer pass) — same design as the reference; on TPU
+the whole schedule fuses into the update step.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..layer_helper import LayerHelper
+from .. import initializer as init
+from . import tensor, ops, nn
+
+
+def _global_step(helper: LayerHelper):
+    gb = helper.main_program.global_block()
+    name = "@LR_DECAY_COUNTER@"
+    if name in gb.vars:
+        return gb.vars[name]
+    var = gb.create_var(name=name, shape=(1,), dtype="float32", persistable=True,
+                        stop_gradient=True)
+    helper.set_variable_initializer(var, init.ConstantInitializer(0.0))
+    return var
+
+
+def _increment_global_step(helper, step):
+    out_name = step.name
+    helper.append_op("increment", inputs={"X": [step.name]},
+                     outputs={"Out": [out_name]}, attrs={"step": 1.0})
+    return step
+
+
+def global_learning_rate_counter():
+    helper = LayerHelper("lr_counter")
+    return _global_step(helper)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    helper = LayerHelper("exponential_decay")
+    step = _global_step(helper)
+    div = step / float(decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    return learning_rate * (decay_rate ** div)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    helper = LayerHelper("natural_exp_decay")
+    step = _global_step(helper)
+    div = step / float(decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    return learning_rate * ops.exp(div * (-decay_rate))
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    helper = LayerHelper("inverse_time_decay")
+    step = _global_step(helper)
+    div = step / float(decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    denom = div * decay_rate + 1.0
+    return tensor.fill_constant([1], "float32", learning_rate) / denom
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    helper = LayerHelper("polynomial_decay")
+    step = _global_step(helper)
+    if cycle:
+        ratio = ops.ceil(step / float(decay_steps))
+        ratio = ops.elementwise_max(ratio, tensor.fill_constant([1], "float32", 1.0))
+        decay_var = ratio * float(decay_steps)
+        frac = step / decay_var
+    else:
+        capped = ops.elementwise_min(step, tensor.fill_constant([1], "float32",
+                                                                float(decay_steps)))
+        frac = capped / float(decay_steps)
+    one = tensor.fill_constant([1], "float32", 1.0)
+    return (learning_rate - end_learning_rate) * ((one - frac) ** power) \
+        + end_learning_rate
+
+
+def piecewise_decay(boundaries, values):
+    """Piecewise-constant lr: implemented as a sum of indicator windows so it
+    stays branch-free inside the compiled step."""
+    helper = LayerHelper("piecewise_decay")
+    step = _global_step(helper)
+    lr = tensor.fill_constant([1], "float32", 0.0)
+    for i, v in enumerate(values):
+        lo = boundaries[i - 1] if i > 0 else None
+        hi = boundaries[i] if i < len(boundaries) else None
+        ind = tensor.fill_constant([1], "float32", 1.0)
+        if lo is not None:
+            ind = ind * _ge_indicator(step, float(lo))
+        if hi is not None:
+            ind = ind * _lt_indicator(step, float(hi))
+        lr = lr + ind * float(v)
+    return lr
+
+
+def _ge_indicator(step, bound):
+    cmp = step >= tensor.fill_constant([1], "float32", bound)
+    return tensor.cast(cmp, "float32")
+
+
+def _lt_indicator(step, bound):
+    cmp = step < tensor.fill_constant([1], "float32", bound)
+    return tensor.cast(cmp, "float32")
+
+
+def noam_decay(d_model, warmup_steps):
+    """Transformer LR schedule (reference learning_rate_scheduler.py:44)."""
+    helper = LayerHelper("noam_decay")
+    step = _global_step(helper) + 1.0
+    a = step ** -0.5
+    b = step * (warmup_steps ** -1.5)
+    return (d_model ** -0.5) * ops.elementwise_min(a, b)

@@ -1,0 +1,716 @@
+"""Neural-net layers DSL.
+
+Capability parity with reference python/paddle/fluid/layers/nn.py (fc :117,
+embedding :229, dynamic_lstm :293, dynamic_gru :597, conv2d :1365,
+pool2d :1838, batch_norm :2000, layer_norm :2151, dropout, softmax,
+softmax_with_cross_entropy :4195, reshape :4382, topk, ...). Layers append
+IR ops; the executor compiles the whole block into one XLA computation.
+"""
+
+from __future__ import annotations
+
+from ..core import ir
+from ..core.ir import seqlen_var_name
+from ..layer_helper import LayerHelper
+from .. import initializer as init
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, is_test=False, name=None):
+    """Fully-connected layer (reference nn.py:117)."""
+    helper = LayerHelper("fc", **locals())
+    dtype = input[0].dtype if isinstance(input, (list, tuple)) else input.dtype
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    param_attrs = param_attr if isinstance(param_attr, (list, tuple)) \
+        else [param_attr] * len(inputs)
+    mul_results = []
+    for inp, pattr in zip(inputs, param_attrs):
+        in_features = 1
+        for d in inp.shape[num_flatten_dims:]:
+            in_features *= d
+        w = helper.create_parameter(pattr, [in_features, size], dtype)
+        tmp = helper.create_variable_for_type_inference(dtype)
+        helper.append_op("mul", inputs={"X": [inp.name], "Y": [w.name]},
+                         outputs={"Out": [tmp.name]},
+                         attrs={"x_num_col_dims": num_flatten_dims,
+                                "y_num_col_dims": 1})
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_variable_for_type_inference(dtype)
+        helper.append_op("sum", inputs={"X": [m.name for m in mul_results]},
+                         outputs={"Out": [pre_bias.name]})
+    pre_act = _append_bias(helper, pre_bias, dim_start=num_flatten_dims)
+    pre_act.lod_level = inputs[0].lod_level
+    return helper.append_activation(pre_act)
+
+
+def _append_bias(helper, input_var, dim_start=1):
+    battr = helper.bias_attr
+    if battr is False:
+        return input_var
+    size = input_var.shape[-1] if input_var.shape else 1
+    b = helper.create_parameter(battr, [size], input_var.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(dtype=input_var.dtype)
+    helper.append_op("elementwise_add",
+                     inputs={"X": [input_var.name], "Y": [b.name]},
+                     outputs={"Out": [out.name]}, attrs={"axis": -1})
+    out.lod_level = input_var.lod_level
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """Embedding lookup (reference nn.py:229). is_sparse maps to the same
+    dense-table gather on TPU (sparse grads become scatter-adds in XLA)."""
+    helper = LayerHelper("embedding", **locals())
+    w = helper.create_parameter(param_attr, size, dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("lookup_table",
+                     inputs={"W": [w.name], "Ids": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"padding_idx": -1 if padding_idx is None else padding_idx,
+                            "is_sparse": is_sparse,
+                            "is_distributed": is_distributed})
+    out.lod_level = input.lod_level
+    return out
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=False, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """LSTM over a variable-length batch (reference nn.py:293). `input` is the
+    x-projection [B, T, 4*size] (apply `fc` first, as in the reference)."""
+    helper = LayerHelper("lstm", **locals())
+    hidden_size = size // 4
+    weight = helper.create_parameter(param_attr, [hidden_size, 4 * hidden_size], dtype)
+    bias = helper.create_parameter(helper.bias_attr, [1, 4 * hidden_size], dtype,
+                                   is_bias=True) if bias_attr is not False else None
+    hidden = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input.name], "Weight": [weight.name]}
+    if bias is not None:
+        inputs["Bias"] = [bias.name]
+    if h_0 is not None:
+        inputs["H0"] = [h_0.name]
+    if c_0 is not None:
+        inputs["C0"] = [c_0.name]
+    seq = helper.ensure_seqlen_var(input)
+    if seq is not None:
+        inputs["SeqLen"] = [seq.name]
+    helper.append_op("lstm", inputs=inputs,
+                     outputs={"Hidden": [hidden.name], "Cell": [cell.name]},
+                     attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+                            "gate_activation": gate_activation,
+                            "cell_activation": cell_activation,
+                            "candidate_activation": candidate_activation})
+    hidden.lod_level = cell.lod_level = input.lod_level
+    return hidden, cell
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None, is_reverse=False,
+                gate_activation="sigmoid", candidate_activation="tanh",
+                h_0=None, name=None):
+    """GRU over a variable-length batch (reference nn.py:597). `input` is the
+    x-projection [B, T, 3*size]."""
+    helper = LayerHelper("gru", **locals())
+    dtype = input.dtype
+    weight = helper.create_parameter(param_attr, [size, 3 * size], dtype)
+    bias = helper.create_parameter(helper.bias_attr, [1, 3 * size], dtype,
+                                   is_bias=True) if bias_attr is not False else None
+    hidden = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input.name], "Weight": [weight.name]}
+    if bias is not None:
+        inputs["Bias"] = [bias.name]
+    if h_0 is not None:
+        inputs["H0"] = [h_0.name]
+    seq = helper.ensure_seqlen_var(input)
+    if seq is not None:
+        inputs["SeqLen"] = [seq.name]
+    helper.append_op("gru", inputs=inputs, outputs={"Hidden": [hidden.name]},
+                     attrs={"is_reverse": is_reverse,
+                            "gate_activation": gate_activation,
+                            "activation": candidate_activation})
+    hidden.lod_level = input.lod_level
+    return hidden
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid"):
+    helper = LayerHelper("gru_unit", **locals())
+    dtype = input.dtype
+    hidden_size = size // 3
+    weight = helper.create_parameter(param_attr, [hidden_size, 3 * hidden_size], dtype)
+    bias = helper.create_parameter(helper.bias_attr, [1, 3 * hidden_size], dtype,
+                                   is_bias=True) if bias_attr is not False else None
+    out_hidden = helper.create_variable_for_type_inference(dtype)
+    reset_h = helper.create_variable_for_type_inference(dtype)
+    gate = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input.name], "HiddenPrev": [hidden.name],
+              "Weight": [weight.name]}
+    if bias is not None:
+        inputs["Bias"] = [bias.name]
+    helper.append_op("gru_unit", inputs=inputs,
+                     outputs={"Hidden": [out_hidden.name],
+                              "ResetHiddenPrev": [reset_h.name],
+                              "Gate": [gate.name]},
+                     attrs={"activation": activation,
+                            "gate_activation": gate_activation})
+    return out_hidden, reset_h, gate
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None):
+    """2-D convolution, NCHW (reference nn.py:1365). `use_cudnn` is accepted
+    for API parity and ignored — XLA owns kernel selection on TPU."""
+    helper = LayerHelper("conv2d", **locals())
+    dtype = input.dtype
+    num_channels = input.shape[1]
+    fsize = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size, filter_size]
+    filter_shape = [num_filters, num_channels // groups] + list(fsize)
+    std = (2.0 / (fsize[0] * fsize[1] * num_channels)) ** 0.5
+    w = helper.create_parameter(param_attr, filter_shape, dtype,
+                                default_initializer=init.NormalInitializer(0.0, std))
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("conv2d",
+                     inputs={"Input": [input.name], "Filter": [w.name]},
+                     outputs={"Output": [pre_bias.name]},
+                     attrs={"strides": _pair(stride), "paddings": _pair(padding),
+                            "dilations": _pair(dilation), "groups": groups})
+    pre_act = _append_bias_channel(helper, pre_bias)
+    return helper.append_activation(pre_act)
+
+
+def _pair(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v, v]
+
+
+def _append_bias_channel(helper, input_var):
+    battr = helper.bias_attr
+    if battr is False:
+        return input_var
+    size = input_var.shape[1] if len(input_var.shape) > 1 else 1
+    b = helper.create_parameter(battr, [size], input_var.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(dtype=input_var.dtype)
+    helper.append_op("elementwise_add",
+                     inputs={"X": [input_var.name], "Y": [b.name]},
+                     outputs={"Out": [out.name]}, attrs={"axis": 1})
+    return out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, param_attr=None,
+                     bias_attr=None, act=None, name=None, use_cudnn=True):
+    helper = LayerHelper("conv2d_transpose", **locals())
+    dtype = input.dtype
+    num_channels = input.shape[1]
+    fsize = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size, filter_size]
+    filter_shape = [num_channels, num_filters] + list(fsize)
+    w = helper.create_parameter(param_attr, filter_shape, dtype)
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("conv2d_transpose",
+                     inputs={"Input": [input.name], "Filter": [w.name]},
+                     outputs={"Output": [pre_bias.name]},
+                     attrs={"strides": _pair(stride), "paddings": _pair(padding),
+                            "dilations": _pair(dilation)})
+    pre_act = _append_bias_channel(helper, pre_bias)
+    return helper.append_activation(pre_act)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
+           global_pooling=False, use_cudnn=True, ceil_mode=False,
+           exclusive=True, name=None):
+    helper = LayerHelper("pool2d", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("pool2d", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"pooling_type": pool_type, "ksize": _pair(pool_size),
+                            "strides": _pair(pool_stride),
+                            "paddings": _pair(pool_padding),
+                            "global_pooling": global_pooling,
+                            "exclusive": exclusive})
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=False):
+    """Batch normalization (reference nn.py:2000)."""
+    helper = LayerHelper("batch_norm", **locals())
+    dtype = input.dtype
+    c_axis = 1 if data_layout == "NCHW" else len(input.shape) - 1
+    channels = input.shape[c_axis]
+    scale = helper.create_parameter(param_attr, [channels], dtype,
+                                    default_initializer=init.ConstantInitializer(1.0))
+    bias = helper.create_parameter(helper.bias_attr, [channels], dtype, is_bias=True)
+    mean = helper.create_parameter(
+        moving_mean_name, [channels], dtype,
+        default_initializer=init.ConstantInitializer(0.0), stop_gradient=True)
+    variance = helper.create_parameter(
+        moving_variance_name, [channels], dtype,
+        default_initializer=init.ConstantInitializer(1.0), stop_gradient=True)
+    mean.trainable = False
+    variance.trainable = False
+    y = helper.create_variable_for_type_inference(dtype)
+    saved_mean = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    saved_var = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op("batch_norm",
+                     inputs={"X": [input.name], "Scale": [scale.name],
+                             "Bias": [bias.name], "Mean": [mean.name],
+                             "Variance": [variance.name]},
+                     outputs={"Y": [y.name], "MeanOut": [mean.name],
+                              "VarianceOut": [variance.name],
+                              "SavedMean": [saved_mean.name],
+                              "SavedVariance": [saved_var.name]},
+                     attrs={"momentum": momentum, "epsilon": epsilon,
+                            "is_test": is_test, "data_layout": data_layout})
+    return helper.append_activation(y)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,
+               param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("layer_norm", **locals())
+    dtype = input.dtype
+    norm_shape = [1]
+    for d in input.shape[begin_norm_axis:]:
+        norm_shape[0] *= d
+    inputs = {"X": [input.name]}
+    if scale:
+        s = helper.create_parameter(param_attr, norm_shape, dtype,
+                                    default_initializer=init.ConstantInitializer(1.0))
+        inputs["Scale"] = [s.name]
+    if shift:
+        b = helper.create_parameter(helper.bias_attr, norm_shape, dtype, is_bias=True)
+        inputs["Bias"] = [b.name]
+    y = helper.create_variable_for_type_inference(dtype)
+    mean = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    var = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op("layer_norm", inputs=inputs,
+                     outputs={"Y": [y.name], "Mean": [mean.name],
+                              "Variance": [var.name]},
+                     attrs={"epsilon": epsilon, "begin_norm_axis": begin_norm_axis})
+    y.lod_level = input.lod_level
+    return helper.append_activation(y)
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    helper = LayerHelper("dropout", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    mask = helper.create_variable_for_type_inference(dtype=x.dtype, stop_gradient=True)
+    helper.append_op("dropout", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name], "Mask": [mask.name]},
+                     attrs={"dropout_prob": dropout_prob, "is_test": is_test,
+                            "dropout_implementation": dropout_implementation})
+    out.lod_level = x.lod_level
+    return out
+
+
+def softmax(input, axis=-1, use_cudnn=True, name=None):
+    helper = LayerHelper("softmax", **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("softmax", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]}, attrs={"axis": axis})
+    out.lod_level = input.lod_level
+    return out
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    helper = LayerHelper("cross_entropy")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("cross_entropy",
+                     inputs={"X": [input.name], "Label": [label.name]},
+                     outputs={"Y": [out.name]},
+                     attrs={"soft_label": soft_label, "ignore_index": ignore_index})
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, return_softmax=False):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    softmax_out = helper.create_variable_for_type_inference(dtype=logits.dtype)
+    loss = helper.create_variable_for_type_inference(dtype=logits.dtype)
+    helper.append_op("softmax_with_cross_entropy",
+                     inputs={"Logits": [logits.name], "Label": [label.name]},
+                     outputs={"Softmax": [softmax_out.name], "Loss": [loss.name]},
+                     attrs={"soft_label": soft_label, "ignore_index": ignore_index})
+    if return_softmax:
+        return loss, softmax_out
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100, name=None):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("sigmoid_cross_entropy_with_logits",
+                     inputs={"X": [x.name], "Label": [label.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"ignore_index": ignore_index})
+    return out
+
+
+def square_error_cost(input, label):
+    helper = LayerHelper("square_error_cost")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("square_error_cost",
+                     inputs={"X": [input.name], "Y": [label.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    helper = LayerHelper("smooth_l1_loss")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    diff = helper.create_variable_for_type_inference(dtype=x.dtype)
+    inputs = {"X": [x.name], "Y": [y.name]}
+    if inside_weight is not None:
+        inputs["InsideWeight"] = [inside_weight.name]
+    if outside_weight is not None:
+        inputs["OutsideWeight"] = [outside_weight.name]
+    helper.append_op("smooth_l1_loss", inputs=inputs,
+                     outputs={"Out": [out.name], "Diff": [diff.name]},
+                     attrs={"sigma": sigma or 1.0})
+    return out
+
+
+def mean(x, name=None):
+    helper = LayerHelper("mean", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("mean", inputs={"X": [x.name]}, outputs={"Out": [out.name]})
+    return out
+
+
+def _reduce_layer(op_type):
+    def layer(input, dim=None, keep_dim=False, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(dtype=input.dtype)
+        if dim is None:
+            attrs = {"reduce_all": True, "keep_dim": keep_dim}
+        else:
+            dims = dim if isinstance(dim, (list, tuple)) else [dim]
+            attrs = {"dim": list(dims), "keep_dim": keep_dim, "reduce_all": False}
+        helper.append_op(op_type, inputs={"X": [input.name]},
+                         outputs={"Out": [out.name]}, attrs=attrs)
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+reduce_sum = _reduce_layer("reduce_sum")
+reduce_mean = _reduce_layer("reduce_mean")
+reduce_max = _reduce_layer("reduce_max")
+reduce_min = _reduce_layer("reduce_min")
+reduce_prod = _reduce_layer("reduce_prod")
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    helper = LayerHelper("reshape", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("reshape", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs={"shape": list(shape)})
+    return helper.append_activation(out)
+
+
+def squeeze(input, axes=None, name=None):
+    helper = LayerHelper("squeeze", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("squeeze", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]}, attrs={"axes": axes or []})
+    return out
+
+
+def unsqueeze(input, axes, name=None):
+    helper = LayerHelper("unsqueeze", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("unsqueeze", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]}, attrs={"axes": list(axes)})
+    return out
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper("transpose", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("transpose", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs={"axis": list(perm)})
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("matmul", inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"transpose_X": transpose_x, "transpose_Y": transpose_y,
+                            "alpha": float(alpha)})
+    return out
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k", name=name)
+    values = helper.create_variable_for_type_inference(dtype=input.dtype)
+    indices = helper.create_variable_for_type_inference(dtype="int64",
+                                                        stop_gradient=True)
+    helper.append_op("top_k", inputs={"X": [input.name]},
+                     outputs={"Out": [values.name], "Indices": [indices.name]},
+                     attrs={"k": k})
+    return values, indices
+
+
+def one_hot(input, depth):
+    helper = LayerHelper("one_hot")
+    out = helper.create_variable_for_type_inference(dtype="float32")
+    helper.append_op("one_hot", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]}, attrs={"depth": depth})
+    return out
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split", name=name)
+    if isinstance(num_or_sections, int):
+        num = num_or_sections
+        sections = []
+    else:
+        num = 0
+        sections = list(num_or_sections)
+    n_outs = num if num else len(sections)
+    outs = [helper.create_variable_for_type_inference(dtype=input.dtype)
+            for _ in range(n_outs)]
+    helper.append_op("split", inputs={"X": [input.name]},
+                     outputs={"Out": [o.name for o in outs]},
+                     attrs={"num": num, "sections": sections, "axis": dim})
+    return outs
+
+
+def slice(input, axes, starts, ends):
+    helper = LayerHelper("slice")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("slice", inputs={"Input": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"axes": list(axes), "starts": list(starts),
+                            "ends": list(ends)})
+    return out
+
+
+def gather(input, index):
+    helper = LayerHelper("gather")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("gather", inputs={"X": [input.name], "Index": [index.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def scatter(input, index, updates, overwrite=True, name=None):
+    helper = LayerHelper("scatter", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("scatter",
+                     inputs={"X": [input.name], "Ids": [index.name],
+                             "Updates": [updates.name]},
+                     outputs={"Out": [out.name]}, attrs={"overwrite": overwrite})
+    return out
+
+
+def expand(x, expand_times, name=None):
+    helper = LayerHelper("expand", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("expand", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"expand_times": list(expand_times)})
+    return out
+
+
+def stack(x, axis=0):
+    helper = LayerHelper("stack")
+    out = helper.create_variable_for_type_inference(dtype=x[0].dtype)
+    helper.append_op("stack", inputs={"X": [v.name for v in x]},
+                     outputs={"Y": [out.name]}, attrs={"axis": axis})
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    helper = LayerHelper("pad", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("pad", inputs={"X": [x.name]}, outputs={"Out": [out.name]},
+                     attrs={"paddings": list(paddings), "pad_value": pad_value})
+    return out
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    helper = LayerHelper("l2_normalize", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    norm = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("l2_normalize", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name], "Norm": [norm.name]},
+                     attrs={"axis": axis, "epsilon": epsilon})
+    return out
+
+
+def clip(x, min, max, name=None):
+    helper = LayerHelper("clip", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("clip", inputs={"X": [x.name]}, outputs={"Out": [out.name]},
+                     attrs={"min": float(min), "max": float(max)})
+    return out
+
+
+def clip_by_norm(x, max_norm, name=None):
+    helper = LayerHelper("clip_by_norm", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("clip_by_norm", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs={"max_norm": float(max_norm)})
+    return out
+
+
+def relu(x, name=None):
+    from . import ops as _ops
+    return _ops.relu(x, name=name)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    helper = LayerHelper("scale", **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("scale", inputs={"X": [x.name]}, outputs={"Out": [out.name]},
+                     attrs={"scale": float(scale), "bias": float(bias),
+                            "bias_after_scale": bias_after_scale})
+    out.lod_level = x.lod_level
+    return helper.append_activation(out)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    helper = LayerHelper("prelu", **locals())
+    if mode == "all":
+        alpha_shape = [1]
+    elif mode == "channel":
+        alpha_shape = [x.shape[1]]
+    else:
+        alpha_shape = [int(d) if d > 0 else 1 for d in x.shape[1:]]
+    alpha = helper.create_parameter(param_attr, alpha_shape, x.dtype,
+                                    default_initializer=init.ConstantInitializer(0.25))
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("prelu", inputs={"X": [x.name], "Alpha": [alpha.name]},
+                     outputs={"Out": [out.name]}, attrs={"mode": mode})
+    return out
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    mid = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("lrn", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name], "MidOut": [mid.name]},
+                     attrs={"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+# -- sequence layers (LoD analogs) ------------------------------------------
+
+def _seq_inputs(helper, x, extra=None):
+    inputs = {"X": [x.name]}
+    seq = helper.ensure_seqlen_var(x)
+    if seq is not None:
+        inputs["SeqLen"] = [seq.name]
+    if extra:
+        inputs.update(extra)
+    return inputs
+
+
+def sequence_pool(input, pool_type, is_test=False):
+    helper = LayerHelper("sequence_pool")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("sequence_pool", inputs=_seq_inputs(helper, input),
+                     outputs={"Out": [out.name]},
+                     attrs={"pooltype": pool_type.upper()})
+    return out
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, "last")
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    helper = LayerHelper("sequence_softmax", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("sequence_softmax", inputs=_seq_inputs(helper, input),
+                     outputs={"Out": [out.name]})
+    out.lod_level = input.lod_level
+    return out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper("sequence_expand", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("sequence_expand",
+                     inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [out.name]}, attrs={"ref_level": ref_level})
+    out.lod_level = y.lod_level
+    return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None):
+    helper = LayerHelper("sequence_conv", **locals())
+    dtype = input.dtype
+    d = input.shape[-1]
+    w = helper.create_parameter(param_attr, [filter_size * d, num_filters], dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("sequence_conv",
+                     inputs=_seq_inputs(helper, input, {"Filter": [w.name]}),
+                     outputs={"Out": [out.name]},
+                     attrs={"contextLength": filter_size,
+                            "contextStart": -(filter_size // 2),
+                            "contextStride": filter_stride})
+    out.lod_level = input.lod_level
+    pre_act = _append_bias(helper, out)
+    return helper.append_activation(pre_act)
+
+
+def sequence_reshape(input, new_dim):
+    helper = LayerHelper("sequence_reshape")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("sequence_reshape", inputs=_seq_inputs(helper, input),
+                     outputs={"Out": [out.name]}, attrs={"new_dim": new_dim})
+    out.lod_level = input.lod_level
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper("row_conv", **locals())
+    d = input.shape[-1]
+    w = helper.create_parameter(param_attr, [future_context_size + 1, d],
+                                input.dtype)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("row_conv",
+                     inputs=_seq_inputs(helper, input, {"Filter": [w.name]}),
+                     outputs={"Out": [out.name]})
+    out.lod_level = input.lod_level
+    return helper.append_activation(out)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    helper = LayerHelper("im2sequence", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    p = _pair(padding)
+    helper.append_op("im2sequence", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"kernels": _pair(filter_size), "strides": _pair(stride),
+                            "paddings": p + p})
+    return out
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op("sequence_mask", inputs={"X": [x.name]},
+                     outputs={"Y": [out.name]},
+                     attrs={"maxlen": maxlen if maxlen else -1, "out_dtype": dtype})
+    return out

@@ -1,0 +1,125 @@
+"""Tensor-manipulation layers (reference: python/paddle/fluid/layers/tensor.py)."""
+
+from __future__ import annotations
+
+from ..core import ir
+from ..layer_helper import LayerHelper
+
+
+def _single_out(helper, op_type, inputs, attrs=None, dtype=None, out_slot="Out",
+                lod_from=None):
+    dtype = dtype or "float32"
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(op_type, inputs=inputs, outputs={out_slot: [out.name]},
+                     attrs=attrs or {})
+    if lod_from is not None and isinstance(lod_from, ir.Variable):
+        out.lod_level = lod_from.lod_level
+    return out
+
+
+def create_tensor(dtype="float32", name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.main_program.current_block().create_var(
+        name=name, dtype=dtype, shape=(), persistable=persistable)
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
+                      name=None):
+    from .. import initializer as init
+    helper = LayerHelper("global_var", name=name)
+    var = helper.create_global_variable(name=name, shape=shape, dtype=dtype,
+                                        persistable=persistable)
+    helper.set_variable_initializer(var, init.ConstantInitializer(value))
+    return var
+
+
+def fill_constant(shape, dtype, value, out=None, name=None):
+    helper = LayerHelper("fill_constant", name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op("fill_constant", outputs={"Out": [out.name]},
+                     attrs={"shape": list(shape), "dtype": dtype, "value": float(value)})
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value, input_dim_idx=0,
+                                  output_dim_idx=0):
+    helper = LayerHelper("fill_constant_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op("fill_constant_batch_size_like",
+                     inputs={"Input": [input.name]}, outputs={"Out": [out.name]},
+                     attrs={"shape": list(shape), "dtype": dtype, "value": float(value),
+                            "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx})
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign")
+    import numpy as np
+    if isinstance(input, ir.Variable):
+        if output is None:
+            output = helper.create_variable_for_type_inference(dtype=input.dtype)
+        helper.append_op("assign", inputs={"X": [input.name]},
+                         outputs={"Out": [output.name]})
+    else:
+        arr = np.asarray(input)
+        if output is None:
+            output = helper.create_variable_for_type_inference(dtype=str(arr.dtype))
+        helper.append_op("assign_value", outputs={"Out": [output.name]},
+                         attrs={"shape": list(arr.shape), "dtype": str(arr.dtype),
+                                "values": [float(v) for v in arr.reshape(-1)]})
+    return output
+
+
+def cast(x, dtype):
+    helper = LayerHelper("cast")
+    return _single_out(helper, "cast", {"X": [x.name]}, {"out_dtype": str(dtype)},
+                       dtype=str(dtype), lod_from=x)
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper("concat", name=name)
+    names = [v.name for v in input]
+    return _single_out(helper, "concat", {"X": names}, {"axis": axis},
+                       dtype=input[0].dtype, lod_from=input[0])
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sum")
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype=input[0].dtype)
+    helper.append_op("sum", inputs={"X": [v.name for v in input]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def argmax(x, axis=0):
+    helper = LayerHelper("arg_max")
+    return _single_out(helper, "arg_max", {"X": [x.name]}, {"axis": axis},
+                       dtype="int64")
+
+
+def argmin(x, axis=0):
+    helper = LayerHelper("arg_min")
+    return _single_out(helper, "arg_min", {"X": [x.name]}, {"axis": axis},
+                       dtype="int64")
+
+
+def argsort(x, axis=-1):
+    raise NotImplementedError("argsort: use topk for ranked retrieval on TPU")
+
+
+def zeros(shape, dtype="float32"):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def ones(shape, dtype="float32"):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def reverse(x, axis):
+    helper = LayerHelper("reverse")
+    axis = [axis] if isinstance(axis, int) else list(axis)
+    return _single_out(helper, "reverse", {"X": [x.name]}, {"axis": axis},
+                       dtype=x.dtype, lod_from=x)

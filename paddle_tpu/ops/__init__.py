@@ -1,0 +1,9 @@
+"""Op library: importing this package registers every lowering rule."""
+
+from . import math  # noqa: F401
+from . import tensor  # noqa: F401
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401
+from . import rnn  # noqa: F401
+from . import sequence  # noqa: F401
+from . import optimizer_ops  # noqa: F401

@@ -1,0 +1,152 @@
+"""Loss / metric op lowerings.
+
+Capability parity with the reference loss family (reference:
+paddle/fluid/operators/{cross_entropy_op.cc,softmax_with_cross_entropy_op.cc,
+sigmoid_cross_entropy_with_logits_op.cc,squared_l2_distance_op.cc,
+smooth_l1_loss_op.cc,huber_loss_op.cc,log_loss_op.cc,rank_loss_op.cc,
+margin_rank_loss_op.cc,hinge_loss_op.cc,accuracy_op.cc,nce_op.cc,...}).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _squeeze_label(Label):
+    if Label.ndim >= 2 and Label.shape[-1] == 1:
+        return Label.reshape(Label.shape[:-1])
+    return Label
+
+
+@register_op("cross_entropy")
+def _cross_entropy(ctx, X, Label):
+    """X is a probability distribution (post-softmax), reference
+    cross_entropy_op.cc semantics; output keeps a trailing 1-dim."""
+    eps = 1e-8
+    if ctx.attr("soft_label", False):
+        loss = -jnp.sum(Label * jnp.log(jnp.maximum(X, eps)), axis=-1, keepdims=True)
+    else:
+        ids = _squeeze_label(Label).astype(jnp.int32)
+        p = jnp.take_along_axis(X, ids[..., None], axis=-1)
+        ignore = ctx.attr("ignore_index", -100)
+        loss = -jnp.log(jnp.maximum(p, eps))
+        loss = jnp.where(ids[..., None] == ignore, 0.0, loss)
+    return {"Y": loss}
+
+
+@register_op("softmax_with_cross_entropy")
+def _softmax_with_cross_entropy(ctx, Logits, Label):
+    """Numerically-stable fused kernel (reference
+    softmax_with_cross_entropy_op.cc). Outputs Softmax and Loss."""
+    logits32 = Logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits32, axis=-1, keepdims=True)
+    log_softmax = logits32 - lse
+    softmax = jnp.exp(log_softmax)
+    if ctx.attr("soft_label", False):
+        loss = -jnp.sum(Label * log_softmax, axis=-1, keepdims=True)
+    else:
+        ids = _squeeze_label(Label).astype(jnp.int32)
+        picked = jnp.take_along_axis(log_softmax, ids[..., None], axis=-1)
+        loss = -picked
+        ignore = ctx.attr("ignore_index", -100)
+        loss = jnp.where(ids[..., None] == ignore, 0.0, loss)
+    return {"Softmax": softmax.astype(Logits.dtype), "Loss": loss.astype(Logits.dtype)}
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def _sigmoid_ce(ctx, X, Label):
+    loss = jnp.maximum(X, 0.0) - X * Label + jnp.log1p(jnp.exp(-jnp.abs(X)))
+    ignore = ctx.attr("ignore_index", -100)
+    loss = jnp.where(Label == ignore, 0.0, loss)
+    return {"Out": loss}
+
+
+@register_op("square_error_cost")
+def _square_error_cost(ctx, X, Y):
+    d = X - Y
+    return {"Out": d * d}
+
+
+@register_op("smooth_l1_loss")
+def _smooth_l1(ctx, X, Y, InsideWeight=None, OutsideWeight=None):
+    sigma = ctx.attr("sigma", 1.0)
+    s2 = sigma * sigma
+    d = X - Y
+    if InsideWeight is not None:
+        d = d * InsideWeight
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < 1.0 / s2, 0.5 * d * d * s2, ad - 0.5 / s2)
+    if OutsideWeight is not None:
+        loss = loss * OutsideWeight
+    loss = jnp.sum(loss.reshape(loss.shape[0], -1), axis=-1, keepdims=True)
+    return {"Out": loss, "Diff": d}
+
+
+@register_op("huber_loss")
+def _huber(ctx, X, Y):
+    delta = ctx.attr("delta", 1.0)
+    d = Y - X
+    ad = jnp.abs(d)
+    loss = jnp.where(ad <= delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+    return {"Out": loss, "Residual": d}
+
+
+@register_op("log_loss")
+def _log_loss(ctx, Predicted, Labels):
+    eps = ctx.attr("epsilon", 1e-4)
+    p = Predicted
+    return {"Loss": -Labels * jnp.log(p + eps) - (1 - Labels) * jnp.log(1 - p + eps)}
+
+
+@register_op("rank_loss")
+def _rank_loss(ctx, Label, Left, Right):
+    d = Left - Right
+    return {"Out": jnp.log1p(jnp.exp(d)) - Label * d}
+
+
+@register_op("margin_rank_loss")
+def _margin_rank_loss(ctx, Label, X1, X2):
+    margin = ctx.attr("margin", 0.0)
+    act = jnp.maximum(0.0, -Label * (X1 - X2) + margin)
+    return {"Out": act, "Activated": (act > 0).astype(X1.dtype)}
+
+
+@register_op("hinge_loss")
+def _hinge_loss(ctx, Logits, Labels):
+    y = Labels * 2.0 - 1.0
+    return {"Loss": jnp.maximum(0.0, 1.0 - y * Logits)}
+
+
+@register_op("accuracy", propagate_seqlen=False)
+def _accuracy(ctx, Out, Indices, Label):
+    """Top-k accuracy (reference accuracy_op.cc): Indices [N,k] from top_k."""
+    label = _squeeze_label(Label).astype(jnp.int64)
+    correct = jnp.any(Indices == label[:, None], axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.int32))
+    total = jnp.int32(label.shape[0])
+    acc = num_correct.astype(jnp.float32) / jnp.float32(label.shape[0])
+    return {"Accuracy": acc.reshape((1,)), "Correct": num_correct.reshape((1,)),
+            "Total": total.reshape((1,))}
+
+
+@register_op("auc", propagate_seqlen=False)
+def _auc(ctx, Predict, Label, StatPos, StatNeg):
+    """Streaming AUC via threshold buckets (reference auc_op.cc)."""
+    num_thresholds = ctx.attr("num_thresholds", 200)
+    pos_prob = Predict[:, 1] if Predict.ndim == 2 and Predict.shape[1] == 2 else Predict.reshape(-1)
+    label = _squeeze_label(Label).astype(jnp.float32).reshape(-1)
+    idx = jnp.clip((pos_prob * num_thresholds).astype(jnp.int32), 0, num_thresholds)
+    pos = StatPos.at[idx].add(label)
+    neg = StatNeg.at[idx].add(1.0 - label)
+    # trapezoid over descending thresholds
+    tp = jnp.cumsum(pos[::-1])
+    fp = jnp.cumsum(neg[::-1])
+    tot_pos = tp[-1]
+    tot_neg = fp[-1]
+    tpr = tp / jnp.maximum(tot_pos, 1.0)
+    fpr = fp / jnp.maximum(tot_neg, 1.0)
+    auc = jnp.trapezoid(tpr, fpr)
+    return {"AUC": auc.reshape((1,)), "StatPosOut": pos, "StatNegOut": neg}

@@ -1,0 +1,289 @@
+"""Math / elementwise / activation / reduction op lowerings.
+
+Capability parity with the reference op families (reference:
+paddle/fluid/operators/elementwise_*.cc, mul_op.cc, matmul_op.cc, scale_op.cc,
+sum_op.cc, activation_op.cc, reduce_op.cc, softmax_op.cc, top_k_op.cc, ...).
+Each op here is a pure JAX lowering rule; XLA fuses them into surrounding
+computations (the reference needed per-op CUDA kernels + manual fusion).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from ..core import types
+
+
+def _align_y(X, Y, axis):
+    """Reference elementwise broadcast semantics (elementwise_op_function.h):
+    Y's dims match a contiguous run of X's dims starting at `axis`."""
+    if Y.ndim == 0 or X.shape == Y.shape or Y.ndim == X.ndim:
+        return Y
+    axis = int(axis)
+    if axis < 0:
+        axis = X.ndim - Y.ndim
+    shape = [1] * axis + list(Y.shape) + [1] * (X.ndim - axis - Y.ndim)
+    return Y.reshape(shape)
+
+
+def _register_elementwise(name, fn):
+    @register_op(name)
+    def _rule(ctx, X, Y, _fn=fn):
+        return {"Out": _fn(X, _align_y(X, Y, ctx.attr("axis", -1)))}
+    _rule.__name__ = name
+    return _rule
+
+
+_register_elementwise("elementwise_add", jnp.add)
+_register_elementwise("elementwise_sub", jnp.subtract)
+_register_elementwise("elementwise_mul", jnp.multiply)
+_register_elementwise("elementwise_div", jnp.divide)
+_register_elementwise("elementwise_max", jnp.maximum)
+_register_elementwise("elementwise_min", jnp.minimum)
+_register_elementwise("elementwise_pow", jnp.power)
+_register_elementwise("elementwise_mod", jnp.mod)
+_register_elementwise("elementwise_floordiv", jnp.floor_divide)
+
+
+@register_op("mul")
+def _mul(ctx, X, Y):
+    """Flattening matmul (reference mul_op.cc): X flattened at
+    x_num_col_dims, Y at y_num_col_dims."""
+    import math as _m
+    xd = ctx.attr("x_num_col_dims", 1)
+    yd = ctx.attr("y_num_col_dims", 1)
+    xs, ys = X.shape, Y.shape
+    x2 = X.reshape((_m.prod(xs[:xd]), _m.prod(xs[xd:])))
+    y2 = Y.reshape((_m.prod(ys[:yd]), _m.prod(ys[yd:])))
+    out = x2 @ y2
+    return {"Out": out.reshape(xs[:xd] + ys[yd:])}
+
+
+@register_op("matmul")
+def _matmul(ctx, X, Y):
+    tx, ty = ctx.attr("transpose_X", False), ctx.attr("transpose_Y", False)
+    alpha = ctx.attr("alpha", 1.0)
+    a = jnp.swapaxes(X, -1, -2) if tx else X
+    b = jnp.swapaxes(Y, -1, -2) if ty else Y
+    out = jnp.matmul(a, b)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": out}
+
+
+@register_op("scale")
+def _scale(ctx, X):
+    s, b = ctx.attr("scale", 1.0), ctx.attr("bias", 0.0)
+    if ctx.attr("bias_after_scale", True):
+        return {"Out": X * s + b}
+    return {"Out": (X + b) * s}
+
+
+@register_op("sum")
+def _sum(ctx, X):
+    xs = X if isinstance(X, list) else [X]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": out}
+
+
+@register_op("mean")
+def _mean(ctx, X):
+    return {"Out": jnp.mean(X).reshape((1,))}
+
+
+@register_op("cast")
+def _cast(ctx, X):
+    return {"Out": X.astype(types.np_dtype(ctx.attr("out_dtype", "float32")))}
+
+
+@register_op("clip")
+def _clip(ctx, X):
+    return {"Out": jnp.clip(X, ctx.attr("min"), ctx.attr("max"))}
+
+
+@register_op("clip_by_norm")
+def _clip_by_norm(ctx, X):
+    max_norm = ctx.attr("max_norm")
+    norm = jnp.sqrt(jnp.sum(X * X))
+    scale = jnp.minimum(max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": X * scale}
+
+
+def _reduce(ctx, X, fn):
+    dims = ctx.attr("dim", [0])
+    keep = ctx.attr("keep_dim", False)
+    if ctx.attr("reduce_all", False):
+        out = fn(X)
+        return out.reshape((1,)) if not keep else out.reshape((1,) * X.ndim)
+    dims = tuple(dims) if isinstance(dims, (list, tuple)) else (dims,)
+    return fn(X, axis=dims, keepdims=keep)
+
+
+for _name, _fn in [("reduce_sum", jnp.sum), ("reduce_mean", jnp.mean),
+                   ("reduce_max", jnp.max), ("reduce_min", jnp.min),
+                   ("reduce_prod", jnp.prod)]:
+    def _make(fn):
+        def rule(ctx, X):
+            return {"Out": _reduce(ctx, X, fn)}
+        return rule
+    register_op(_name)(_make(_fn))
+
+
+# -- activations (reference activation_op.cc) -------------------------------
+
+def _register_act(name, fn):
+    @register_op(name)
+    def _rule(ctx, X, _fn=fn):
+        return {"Out": _fn(ctx, X)}
+    return _rule
+
+
+_register_act("relu", lambda ctx, x: jax.nn.relu(x))
+_register_act("relu6", lambda ctx, x: jnp.clip(x, 0.0, ctx.attr("threshold", 6.0)))
+_register_act("sigmoid", lambda ctx, x: jax.nn.sigmoid(x))
+_register_act("logsigmoid", lambda ctx, x: jax.nn.log_sigmoid(x))
+_register_act("tanh", lambda ctx, x: jnp.tanh(x))
+_register_act("tanh_shrink", lambda ctx, x: x - jnp.tanh(x))
+_register_act("exp", lambda ctx, x: jnp.exp(x))
+_register_act("log", lambda ctx, x: jnp.log(x))
+_register_act("sqrt", lambda ctx, x: jnp.sqrt(x))
+_register_act("rsqrt", lambda ctx, x: lax.rsqrt(x))
+_register_act("abs", lambda ctx, x: jnp.abs(x))
+_register_act("square", lambda ctx, x: jnp.square(x))
+_register_act("reciprocal", lambda ctx, x: 1.0 / x)
+_register_act("sign", lambda ctx, x: jnp.sign(x))
+_register_act("floor", lambda ctx, x: jnp.floor(x))
+_register_act("ceil", lambda ctx, x: jnp.ceil(x))
+_register_act("round", lambda ctx, x: jnp.round(x))
+_register_act("cos", lambda ctx, x: jnp.cos(x))
+_register_act("sin", lambda ctx, x: jnp.sin(x))
+_register_act("softplus", lambda ctx, x: jax.nn.softplus(x))
+_register_act("softsign", lambda ctx, x: x / (1.0 + jnp.abs(x)))
+_register_act("gelu", lambda ctx, x: jax.nn.gelu(x, approximate=False))
+_register_act("leaky_relu", lambda ctx, x: jnp.where(x >= 0, x, x * ctx.attr("alpha", 0.02)))
+_register_act("elu", lambda ctx, x: jax.nn.elu(x, alpha=ctx.attr("alpha", 1.0)))
+_register_act("swish", lambda ctx, x: x * jax.nn.sigmoid(ctx.attr("beta", 1.0) * x))
+_register_act("hard_sigmoid",
+              lambda ctx, x: jnp.clip(ctx.attr("slope", 0.2) * x + ctx.attr("offset", 0.5),
+                                      0.0, 1.0))
+_register_act("brelu", lambda ctx, x: jnp.clip(x, ctx.attr("t_min", 0.0),
+                                               ctx.attr("t_max", 24.0)))
+_register_act("soft_relu",
+              lambda ctx, x: jnp.log(1 + jnp.exp(jnp.clip(x, -ctx.attr("threshold", 40.0),
+                                                          ctx.attr("threshold", 40.0)))))
+_register_act("pow", lambda ctx, x: jnp.power(x, ctx.attr("factor", 1.0)))
+_register_act("hard_shrink",
+              lambda ctx, x: jnp.where(jnp.abs(x) > ctx.attr("threshold", 0.5), x, 0.0))
+_register_act("softshrink",
+              lambda ctx, x: jnp.where(x > ctx.attr("lambda", 0.5), x - ctx.attr("lambda", 0.5),
+                                       jnp.where(x < -ctx.attr("lambda", 0.5),
+                                                 x + ctx.attr("lambda", 0.5), 0.0)))
+_register_act("thresholded_relu",
+              lambda ctx, x: jnp.where(x > ctx.attr("threshold", 1.0), x, 0.0))
+
+
+@register_op("prelu")
+def _prelu(ctx, X, Alpha):
+    mode = ctx.attr("mode", "all")
+    if mode == "channel" and Alpha.ndim == 1 and X.ndim == 4:
+        alpha = Alpha.reshape((1, -1, 1, 1))
+    else:
+        alpha = Alpha
+    return {"Out": jnp.where(X >= 0, X, X * alpha)}
+
+
+@register_op("softmax")
+def _softmax(ctx, X):
+    return {"Out": jax.nn.softmax(X, axis=ctx.attr("axis", -1))}
+
+
+@register_op("log_softmax")
+def _log_softmax(ctx, X):
+    return {"Out": jax.nn.log_softmax(X, axis=ctx.attr("axis", -1))}
+
+
+@register_op("cumsum")
+def _cumsum(ctx, X):
+    axis = ctx.attr("axis", -1)
+    out = jnp.cumsum(X, axis=axis)
+    if ctx.attr("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(X, axis), axis=axis), axis)
+    if ctx.attr("exclusive", False):
+        out = out - X
+    return {"Out": out}
+
+
+@register_op("top_k", propagate_seqlen=False)
+def _top_k(ctx, X):
+    vals, idx = lax.top_k(X, ctx.attr("k", 1))
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("arg_max", propagate_seqlen=False)
+def _arg_max(ctx, X):
+    return {"Out": jnp.argmax(X, axis=ctx.attr("axis", -1)).astype(jnp.int64)}
+
+
+@register_op("arg_min", propagate_seqlen=False)
+def _arg_min(ctx, X):
+    return {"Out": jnp.argmin(X, axis=ctx.attr("axis", -1)).astype(jnp.int64)}
+
+
+# -- comparisons / logicals (reference compare_op.cc, logical_op.cc) --------
+
+def _register_cmp(name, fn):
+    @register_op(name)
+    def _rule(ctx, X, Y, _fn=fn):
+        return {"Out": _fn(X, _align_y(X, Y, ctx.attr("axis", -1)))}
+    return _rule
+
+
+_register_cmp("equal", jnp.equal)
+_register_cmp("not_equal", jnp.not_equal)
+_register_cmp("less_than", jnp.less)
+_register_cmp("less_equal", jnp.less_equal)
+_register_cmp("greater_than", jnp.greater)
+_register_cmp("greater_equal", jnp.greater_equal)
+_register_cmp("logical_and", jnp.logical_and)
+_register_cmp("logical_or", jnp.logical_or)
+_register_cmp("logical_xor", jnp.logical_xor)
+
+
+@register_op("logical_not")
+def _logical_not(ctx, X):
+    return {"Out": jnp.logical_not(X)}
+
+
+@register_op("isfinite")
+def _isfinite(ctx, X):
+    xs = X if isinstance(X, list) else [X]
+    ok = jnp.array(True)
+    for x in xs:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(x)))
+    return {"Out": ok.reshape((1,))}
+
+
+@register_op("maximum")
+def _maximum(ctx, X, Y):
+    return {"Out": jnp.maximum(X, Y)}
+
+
+@register_op("l2_normalize")
+def _l2_normalize(ctx, X):
+    axis = ctx.attr("axis", -1)
+    eps = ctx.attr("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(X * X, axis=axis, keepdims=True))
+    return {"Out": X / jnp.maximum(norm, eps), "Norm": norm}
+
+
+@register_op("cos_sim")
+def _cos_sim(ctx, X, Y):
+    xn = jnp.sqrt(jnp.sum(X * X, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(Y * Y, axis=-1, keepdims=True))
+    out = jnp.sum(X * Y, axis=-1, keepdims=True) / jnp.maximum(xn * yn, 1e-12)
+    return {"Out": out, "XNorm": xn, "YNorm": yn}

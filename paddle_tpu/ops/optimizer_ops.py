@@ -1,0 +1,154 @@
+"""Optimizer update ops.
+
+Capability parity with the reference's optimizer op kernels (reference:
+paddle/fluid/operators/{sgd,momentum,adam,adamax,adagrad,decayed_adagrad,
+adadelta,rmsprop,ftrl,proximal_gd,proximal_adagrad}_op.cc).
+
+Each update is a pure rule `new_state = f(param, grad, state, lr)`; the
+executor writes outputs back onto the same persistable variables and donates
+their buffers to XLA, so updates are in-place in HBM and fuse into the step.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+def _lr(LearningRate):
+    return LearningRate.reshape(()) if hasattr(LearningRate, "reshape") else LearningRate
+
+
+@register_op("sgd", propagate_seqlen=False)
+def _sgd(ctx, Param, Grad, LearningRate):
+    return {"ParamOut": Param - _lr(LearningRate) * Grad.astype(Param.dtype)}
+
+
+@register_op("momentum", propagate_seqlen=False)
+def _momentum(ctx, Param, Grad, Velocity, LearningRate):
+    mu = ctx.attr("mu", 0.9)
+    lr = _lr(LearningRate)
+    v = mu * Velocity + Grad
+    if ctx.attr("use_nesterov", False):
+        p = Param - (Grad + mu * v) * lr
+    else:
+        p = Param - lr * v
+    return {"ParamOut": p, "VelocityOut": v}
+
+
+@register_op("adam", propagate_seqlen=False)
+def _adam(ctx, Param, Grad, Moment1, Moment2, Beta1Pow, Beta2Pow, LearningRate):
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    lr = _lr(LearningRate)
+    m1 = b1 * Moment1 + (1 - b1) * Grad
+    m2 = b2 * Moment2 + (1 - b2) * Grad * Grad
+    lr_t = lr * jnp.sqrt(1 - Beta2Pow.reshape(())) / (1 - Beta1Pow.reshape(()))
+    p = Param - lr_t * m1 / (jnp.sqrt(m2) + eps)
+    return {"ParamOut": p, "Moment1Out": m1, "Moment2Out": m2,
+            "Beta1PowOut": Beta1Pow * b1, "Beta2PowOut": Beta2Pow * b2}
+
+
+@register_op("adamax", propagate_seqlen=False)
+def _adamax(ctx, Param, Grad, Moment, InfNorm, Beta1Pow, LearningRate):
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    lr = _lr(LearningRate)
+    m = b1 * Moment + (1 - b1) * Grad
+    u = jnp.maximum(b2 * InfNorm, jnp.abs(Grad))
+    p = Param - (lr / (1 - Beta1Pow.reshape(()))) * m / (u + eps)
+    return {"ParamOut": p, "MomentOut": m, "InfNormOut": u,
+            "Beta1PowOut": Beta1Pow * b1}
+
+
+@register_op("adagrad", propagate_seqlen=False)
+def _adagrad(ctx, Param, Grad, Moment, LearningRate):
+    eps = ctx.attr("epsilon", 1e-6)
+    m = Moment + Grad * Grad
+    p = Param - _lr(LearningRate) * Grad / (jnp.sqrt(m) + eps)
+    return {"ParamOut": p, "MomentOut": m}
+
+
+@register_op("decayed_adagrad", propagate_seqlen=False)
+def _decayed_adagrad(ctx, Param, Grad, Moment, LearningRate):
+    decay = ctx.attr("decay", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    m = decay * Moment + (1 - decay) * Grad * Grad
+    p = Param - _lr(LearningRate) * Grad / (jnp.sqrt(m) + eps)
+    return {"ParamOut": p, "MomentOut": m}
+
+
+@register_op("adadelta", propagate_seqlen=False)
+def _adadelta(ctx, Param, Grad, AvgSquaredGrad, AvgSquaredUpdate):
+    rho = ctx.attr("rho", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    g2 = rho * AvgSquaredGrad + (1 - rho) * Grad * Grad
+    update = -jnp.sqrt((AvgSquaredUpdate + eps) / (g2 + eps)) * Grad
+    u2 = rho * AvgSquaredUpdate + (1 - rho) * update * update
+    return {"ParamOut": Param + update, "AvgSquaredGradOut": g2,
+            "AvgSquaredUpdateOut": u2}
+
+
+@register_op("rmsprop", propagate_seqlen=False)
+def _rmsprop(ctx, Param, Grad, MeanSquare, Moment, LearningRate, MeanGrad=None):
+    rho = ctx.attr("decay", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    mu = ctx.attr("momentum", 0.0)
+    lr = _lr(LearningRate)
+    ms = rho * MeanSquare + (1 - rho) * Grad * Grad
+    if ctx.attr("centered", False) and MeanGrad is not None:
+        mg = rho * MeanGrad + (1 - rho) * Grad
+        denom = lax.rsqrt(ms - mg * mg + eps)
+        mom = mu * Moment + lr * Grad * denom
+        return {"ParamOut": Param - mom, "MeanSquareOut": ms, "MomentOut": mom,
+                "MeanGradOut": mg}
+    mom = mu * Moment + lr * Grad * lax.rsqrt(ms + eps)
+    return {"ParamOut": Param - mom, "MeanSquareOut": ms, "MomentOut": mom}
+
+
+@register_op("ftrl", propagate_seqlen=False)
+def _ftrl(ctx, Param, Grad, SquaredAccumulator, LinearAccumulator, LearningRate):
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    lr_power = ctx.attr("lr_power", -0.5)
+    lr = _lr(LearningRate)
+    new_sq = SquaredAccumulator + Grad * Grad
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(SquaredAccumulator)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -lr_power) - jnp.power(SquaredAccumulator, -lr_power)) / lr
+    lin = LinearAccumulator + Grad - sigma * Param
+    if lr_power == -0.5:
+        x = -lin
+        y = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        x = -lin
+        y = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    pre_shrink = (x + jnp.sign(lin) * l1) / y
+    p = jnp.where(jnp.abs(lin) > l1, pre_shrink, 0.0)
+    return {"ParamOut": p, "SquaredAccumOut": new_sq, "LinearAccumOut": lin}
+
+
+@register_op("proximal_gd", propagate_seqlen=False)
+def _proximal_gd(ctx, Param, Grad, LearningRate):
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    lr = _lr(LearningRate)
+    prox = Param - lr * Grad
+    p = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / (1.0 + lr * l2)
+    return {"ParamOut": p}
+
+
+@register_op("proximal_adagrad", propagate_seqlen=False)
+def _proximal_adagrad(ctx, Param, Grad, Moment, LearningRate):
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    m = Moment + Grad * Grad
+    lr = _lr(LearningRate) / jnp.sqrt(m + 1e-12)
+    prox = Param - lr * Grad
+    p = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / (1.0 + lr * l2)
+    return {"ParamOut": p, "MomentOut": m}

@@ -1,0 +1,145 @@
+"""Sequence op lowerings over padded variable-length batches.
+
+Capability parity with the reference's LoD sequence op family (reference:
+paddle/fluid/operators/sequence_{pool,softmax,expand,...}_op.cc; LoD design
+doc/fluid/design/concepts/lod_tensor.md). TPU-native redesign: LoD offset
+tables become a `@SEQLEN` length vector over a padded dense batch; every op
+here is masking + reductions that XLA fuses, preserving the reference's
+"no effective padding compute" property for the common ops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _time_mask(SeqLen, T, dtype=jnp.float32):
+    return (jnp.arange(T)[None, :] < SeqLen.reshape(-1, 1)).astype(dtype)
+
+
+@register_op("sequence_pool", propagate_seqlen=False)
+def _sequence_pool(ctx, X, SeqLen=None):
+    """[B, T, D] (+lengths) -> [B, D]. pool_type in
+    {average, sum, sqrt, max, last, first} (reference sequence_pool_op.cc)."""
+    ptype = ctx.attr("pooltype", "AVERAGE").lower()
+    B, T = X.shape[0], X.shape[1]
+    L = SeqLen if SeqLen is not None else jnp.full((B,), T, jnp.int32)
+    m = _time_mask(L, T, X.dtype)
+    while m.ndim < X.ndim:
+        m = m[..., None]
+    if ptype == "sum":
+        out = jnp.sum(X * m, axis=1)
+    elif ptype == "average":
+        out = jnp.sum(X * m, axis=1) / jnp.maximum(L.astype(X.dtype), 1.0).reshape(-1, *([1] * (X.ndim - 2)))
+    elif ptype == "sqrt":
+        out = jnp.sum(X * m, axis=1) / jnp.sqrt(jnp.maximum(L.astype(X.dtype), 1.0)).reshape(-1, *([1] * (X.ndim - 2)))
+    elif ptype == "max":
+        neg = jnp.finfo(X.dtype).min if jnp.issubdtype(X.dtype, jnp.floating) else jnp.iinfo(X.dtype).min
+        out = jnp.max(jnp.where(m > 0, X, neg), axis=1)
+    elif ptype == "last":
+        idx = jnp.maximum(L - 1, 0).reshape(-1, 1, *([1] * (X.ndim - 2)))
+        out = jnp.take_along_axis(X, idx.astype(jnp.int32), axis=1)[:, 0]
+    elif ptype == "first":
+        out = X[:, 0]
+    else:
+        raise ValueError(f"unknown pooltype {ptype}")
+    return {"Out": out}
+
+
+@register_op("sequence_softmax", propagate_seqlen=False)
+def _sequence_softmax(ctx, X, SeqLen=None):
+    """Softmax over the time axis within each row's valid prefix."""
+    B, T = X.shape[0], X.shape[1]
+    L = SeqLen if SeqLen is not None else jnp.full((B,), T, jnp.int32)
+    m = _time_mask(L, T, jnp.float32)
+    while m.ndim < X.ndim:
+        m = m[..., None]
+    neg = jnp.finfo(jnp.float32).min
+    logits = jnp.where(m > 0, X.astype(jnp.float32), neg)
+    out = jax.nn.softmax(logits, axis=1) * m
+    return {"Out": out.astype(X.dtype)}
+
+
+@register_op("sequence_expand", propagate_seqlen=False)
+def _sequence_expand(ctx, X, Y, SeqLen=None):
+    """Broadcast per-row features over Y's time axis
+    (reference sequence_expand_op.cc, ref_level=0 case):
+    X [B, D] or [B, 1, D] -> [B, T_y, D]."""
+    x = X if X.ndim == 3 else X[:, None, :]
+    T = Y.shape[1]
+    return {"Out": jnp.broadcast_to(x, (x.shape[0], T, x.shape[-1]))}
+
+
+@register_op("sequence_reshape", propagate_seqlen=False)
+def _sequence_reshape(ctx, X, SeqLen=None):
+    new_dim = ctx.attr("new_dim")
+    B, T, D = X.shape
+    assert (T * D) % new_dim == 0
+    return {"Out": X.reshape(B, (T * D) // new_dim, new_dim)}
+
+
+@register_op("sequence_concat", propagate_seqlen=False)
+def _sequence_concat(ctx, X):
+    xs = X if isinstance(X, list) else [X]
+    return {"Out": jnp.concatenate(xs, axis=1)}
+
+
+@register_op("sequence_slice", propagate_seqlen=False)
+def _sequence_slice(ctx, X, Offset, Length):
+    off = int(Offset.reshape(-1)[0]) if not hasattr(Offset, "aval") else Offset
+    raise NotImplementedError("sequence_slice requires static offsets on TPU; "
+                              "use layers.slice instead")
+
+
+@register_op("sequence_conv", propagate_seqlen=False)
+def _sequence_conv(ctx, X, Filter, SeqLen=None, PaddingData=None):
+    """Context-window conv over time (reference sequence_conv_op.cc):
+    X [B, T, D], Filter [ctx_len*D, M] -> [B, T, M]."""
+    ctx_len = ctx.attr("contextLength", 3)
+    ctx_start = ctx.attr("contextStart", -(ctx_len // 2))
+    B, T, D = X.shape
+    L = SeqLen if SeqLen is not None else jnp.full((B,), T, jnp.int32)
+    m = _time_mask(L, T, X.dtype)[..., None]
+    xm = X * m
+    cols = []
+    for i in range(ctx_len):
+        shift = ctx_start + i
+        rolled = jnp.roll(xm, -shift, axis=1)
+        t = jnp.arange(T)
+        valid = ((t + shift >= 0) & (t + shift < T)).astype(X.dtype).reshape(1, T, 1)
+        cols.append(rolled * valid)
+    ctx_mat = jnp.concatenate(cols, axis=-1)          # [B, T, ctx_len*D]
+    out = ctx_mat @ Filter                            # [B, T, M]
+    return {"Out": out * m}
+
+
+@register_op("sequence_erase", propagate_seqlen=False)
+def _sequence_erase(ctx, X, SeqLen=None):
+    raise NotImplementedError(
+        "sequence_erase changes lengths dynamically; preprocess on host instead")
+
+
+@register_op("sequence_expand_as", propagate_seqlen=False)
+def _sequence_expand_as(ctx, X, Y):
+    x = X if X.ndim == 3 else X[:, None, :]
+    return {"Out": jnp.broadcast_to(x, (x.shape[0], Y.shape[1], x.shape[-1]))}
+
+
+@register_op("row_conv", propagate_seqlen=False)
+def _row_conv(ctx, X, Filter, SeqLen=None):
+    """Lookahead row convolution (reference row_conv_op.cc):
+    X [B, T, D], Filter [future_ctx, D]."""
+    future, D = Filter.shape
+    B, T, _ = X.shape
+    out = jnp.zeros_like(X)
+    for i in range(future):
+        rolled = jnp.roll(X, -i, axis=1)
+        t = jnp.arange(T)
+        valid = (t + i < T).astype(X.dtype).reshape(1, T, 1)
+        out = out + rolled * valid * Filter[i].reshape(1, 1, D)
+    if SeqLen is not None:
+        out = out * _time_mask(SeqLen, T, X.dtype)[..., None]
+    return {"Out": out}

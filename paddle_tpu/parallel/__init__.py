@@ -1,0 +1,10 @@
+"""Parallel execution over TPU meshes (GSPMD/pjit).
+
+Replaces the reference's multi-device machinery (SSA-graph ParallelExecutor +
+NCCL, reference paddle/fluid/framework/details/) with sharding annotations
+over a `jax.sharding.Mesh`: XLA GSPMD inserts the collectives (psum /
+all-gather / reduce-scatter) that the reference issued by hand.
+"""
+
+from .parallel_executor import ParallelExecutor, BuildStrategy, ExecutionStrategy  # noqa: F401
+from .mesh import get_default_mesh, make_mesh  # noqa: F401

@@ -1,0 +1,42 @@
+"""Device mesh helpers.
+
+The reference enumerates CUDAPlaces and builds NCCL communicators per device
+(reference: platform/nccl_helper.h:81 NCCLContextMap). TPU-native: a
+`jax.sharding.Mesh` over all local (or all distributed) devices; axes are
+named so programs can shard over data ('dp'), model ('mp'/'tp'), pipeline
+('pp'), and sequence ('sp') dimensions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def make_mesh(axis_sizes: Sequence[int], axis_names: Sequence[str],
+              devices=None) -> Mesh:
+    devices = list(devices) if devices is not None else jax.devices()
+    n = int(np.prod(axis_sizes))
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(axis_sizes)
+    return Mesh(arr, tuple(axis_names))
+
+
+def get_default_mesh(num_devices: Optional[int] = None) -> Mesh:
+    """1-D data-parallel mesh over all devices (ParallelExecutor default)."""
+    devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return make_mesh([len(devices)], ["dp"], devices)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharded(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(axis))

@@ -1,0 +1,75 @@
+"""Profiler (reference: python/paddle/fluid/profiler.py + platform/profiler.cc).
+
+TPU-native redesign: the reference's CUPTI device tracer + event profiler map
+onto the JAX/XLA profiler, which captures both host events and device (TPU)
+trace timelines into TensorBoard/perfetto format. The `profiler` context
+manager keeps the reference API shape (state, sorted_key, output path).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from collections import defaultdict
+
+import jax
+
+_events = []
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile"):
+    """reference profiler.py:profiler — wraps jax.profiler trace capture."""
+    if state not in ("CPU", "GPU", "TPU", "All"):
+        raise ValueError("state must be CPU / TPU / All")
+    os.makedirs(profile_path, exist_ok=True)
+    jax.profiler.start_trace(profile_path)
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        dt = time.time() - t0
+        print(f"[paddle_tpu.profiler] trace written to {profile_path} "
+              f"(wall {dt:.3f}s); view with TensorBoard or perfetto")
+
+
+@contextlib.contextmanager
+def record_event(name: str):
+    """reference platform::RecordEvent analog -> jax named annotation."""
+    with jax.profiler.TraceAnnotation(name):
+        t0 = time.time()
+        yield
+        _events.append((name, time.time() - t0))
+
+
+def start_profiler(state="All", profile_path="/tmp/profile"):
+    os.makedirs(profile_path, exist_ok=True)
+    jax.profiler.start_trace(profile_path)
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    jax.profiler.stop_trace()
+
+
+def reset_profiler():
+    _events.clear()
+
+
+@contextlib.contextmanager
+def cuda_profiler(*a, **kw):
+    """Accepted for reference API parity; TPU traces are captured by
+    `profiler` above."""
+    yield
+
+
+def print_host_events():
+    agg = defaultdict(lambda: [0, 0.0])
+    for name, dt in _events:
+        agg[name][0] += 1
+        agg[name][1] += dt
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][1])
+    print(f"{'Event':<40} {'Calls':>8} {'Total(s)':>12} {'Avg(ms)':>10}")
+    for name, (calls, total) in rows:
+        print(f"{name:<40} {calls:>8} {total:>12.4f} {1000*total/calls:>10.3f}")

@@ -1,0 +1,41 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh so multi-chip
+sharding logic is exercised without TPU hardware (the driver separately
+dry-runs the multichip path)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# the axon sitecustomize force-registers the TPU backend and overrides
+# jax_platforms; tests must run on the virtual 8-device CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Give every test fresh default programs + scope + name counter
+    (reference tests use prog_scope decorators)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import ir, executor
+    from paddle_tpu import unique_name
+
+    prev_main, prev_startup = ir._main_program, ir._startup_program
+    prev_scope = executor._global_scope
+    ir._main_program = ir.Program()
+    ir._startup_program = ir.Program()
+    executor._global_scope = executor.Scope()
+    gen = unique_name._generator
+    unique_name._generator = unique_name.UniqueNameGenerator()
+    np.random.seed(42)
+    yield
+    ir._main_program, ir._startup_program = prev_main, prev_startup
+    executor._global_scope = prev_scope
+    unique_name._generator = gen

@@ -1,0 +1,154 @@
+"""Numeric-vs-analytic gradient checks through the program-level backward.
+
+Models the reference OpTest.check_grad machinery (reference:
+python/paddle/fluid/tests/unittests/op_test.py:388 `check_grad`,
+`get_numeric_gradient` :48): build a one-op (or small) program, append
+backward, compare the emitted grad ops' results against finite differences.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.backward import append_backward
+
+
+def _check_grad(build_fn, feeds, wrt, rtol=1e-2, atol=1e-3, delta=1e-3):
+    """build_fn() -> (input_vars dict, loss_var). Compares d loss/d feeds[wrt]
+    computed by the framework's grad ops vs finite differences."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        in_vars, loss = build_fn()
+        append_backward(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        grad_name = wrt + "@GRAD"
+        analytic, = exe.run(main, feed=feeds, fetch_list=[grad_name])
+
+        def eval_loss(x):
+            f = dict(feeds)
+            f[wrt] = x
+            out, = exe.run(main, feed=f, fetch_list=[loss])
+            return float(np.asarray(out).reshape(-1)[0])
+
+        x0 = np.asarray(feeds[wrt], np.float32)
+        numeric = np.zeros_like(x0).reshape(-1)
+        flat = x0.reshape(-1)
+        for i in range(flat.size):
+            xp = flat.copy(); xp[i] += delta
+            xm = flat.copy(); xm[i] -= delta
+            numeric[i] = (eval_loss(xp.reshape(x0.shape))
+                          - eval_loss(xm.reshape(x0.shape))) / (2 * delta)
+        np.testing.assert_allclose(np.asarray(analytic).reshape(-1), numeric,
+                                   rtol=rtol, atol=atol)
+
+
+def _data(name, shape, dtype="float32", stop_grad=False):
+    v = fluid.layers.data(name=name, shape=shape, dtype=dtype,
+                          append_batch_size=False)
+    v.stop_gradient = stop_grad
+    return v
+
+
+def test_matmul_grad():
+    def build():
+        x = _data("x", [3, 4])
+        y = _data("y", [4, 2])
+        out = fluid.layers.matmul(x, y)
+        return {"x": x, "y": y}, fluid.layers.mean(out)
+
+    feeds = {"x": np.random.randn(3, 4).astype(np.float32),
+             "y": np.random.randn(4, 2).astype(np.float32)}
+    _check_grad(build, feeds, "x")
+
+
+def test_softmax_with_cross_entropy_grad():
+    def build():
+        logits = _data("logits", [4, 5])
+        label = _data("label", [4, 1], "int64", stop_grad=True)
+        loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+        return {}, fluid.layers.mean(loss)
+
+    feeds = {"logits": np.random.randn(4, 5).astype(np.float32),
+             "label": np.random.randint(0, 5, (4, 1)).astype(np.int64)}
+    _check_grad(build, feeds, "logits")
+
+
+def test_conv2d_grad():
+    def build():
+        x = _data("x", [2, 3, 8, 8])
+        y = fluid.layers.conv2d(input=x, num_filters=4, filter_size=3,
+                                padding=1, bias_attr=False)
+        return {}, fluid.layers.mean(y)
+
+    feeds = {"x": np.random.randn(2, 3, 8, 8).astype(np.float32)}
+    _check_grad(build, feeds, "x", rtol=2e-2, atol=2e-3)
+
+
+def test_fanin_sum_grad():
+    """x used by two consumers -> grads must be accumulated via sum op
+    (reference _addup_repetitive_outputs_)."""
+
+    def build():
+        x = _data("x", [3, 3])
+        a = fluid.layers.relu(x)
+        b = fluid.layers.tanh(x)
+        out = fluid.layers.elementwise_add(a, b)
+        return {}, fluid.layers.mean(out)
+
+    feeds = {"x": (np.random.randn(3, 3) + 0.5).astype(np.float32)}
+    _check_grad(build, feeds, "x")
+    # structural: a sum op exists merging the two contributions
+
+
+def test_layer_norm_grad():
+    def build():
+        x = _data("x", [4, 6])
+        y = fluid.layers.layer_norm(x, begin_norm_axis=1)
+        return {}, fluid.layers.mean(y * y)
+
+    feeds = {"x": np.random.randn(4, 6).astype(np.float32)}
+    _check_grad(build, feeds, "x", rtol=2e-2, atol=2e-3)
+
+
+def test_lstm_grad():
+    def build():
+        x = _data("x", [2, 5, 16])  # [B, T, 4H], H=4
+        h, c = fluid.layers.dynamic_lstm(input=x, size=16, bias_attr=False)
+        return {}, fluid.layers.mean(h)
+
+    feeds = {"x": np.random.randn(2, 5, 16).astype(np.float32)}
+    _check_grad(build, feeds, "x", rtol=2e-2, atol=2e-3)
+
+
+def test_batch_norm_grad():
+    def build():
+        x = _data("x", [4, 3, 5, 5])
+        y = fluid.layers.batch_norm(input=x)
+        return {}, fluid.layers.mean(y * y)
+
+    feeds = {"x": np.random.randn(4, 3, 5, 5).astype(np.float32)}
+    _check_grad(build, feeds, "x", rtol=2e-2, atol=2e-2)
+
+
+def test_embedding_grad_is_scatter():
+    """Embedding table grads: rows referenced twice accumulate."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[4, 1], dtype="int64",
+                                append_batch_size=False)
+        emb = fluid.layers.embedding(ids, size=[10, 3],
+                                     param_attr=fluid.ParamAttr(name="emb_w"))
+        loss = fluid.layers.mean(emb)
+        append_backward(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        g, = exe.run(main, feed={"ids": np.array([[1], [1], [2], [3]], np.int64)},
+                     fetch_list=["emb_w@GRAD"])
+    g = np.asarray(g)
+    # row 1 hit twice -> twice the grad of rows 2,3; untouched rows zero
+    np.testing.assert_allclose(g[1], 2 * g[2], rtol=1e-5)
+    assert np.abs(g[0]).sum() == 0
+    assert np.abs(g[4:]).sum() == 0

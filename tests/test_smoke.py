@@ -1,0 +1,87 @@
+"""End-to-end smoke: regression + MNIST-style CNN training converge.
+
+Models the reference's book tests (reference:
+python/paddle/fluid/tests/book/test_fit_a_line.py,
+test_recognize_digits.py) — trained on synthetic data for hermeticity.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_fit_a_line_converges():
+    np.random.seed(0)
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1, act=None)
+    cost = fluid.layers.square_error_cost(input=pred, label=y)
+    avg_cost = fluid.layers.mean(cost)
+    sgd = fluid.optimizer.SGD(learning_rate=0.01)
+    sgd.minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    w_true = np.random.randn(13, 1).astype(np.float32)
+    losses = []
+    for i in range(80):
+        xs = np.random.randn(32, 13).astype(np.float32)
+        ys = xs @ w_true + 0.01 * np.random.randn(32, 1).astype(np.float32)
+        loss, = exe.run(feed={"x": xs, "y": ys}, fetch_list=[avg_cost])
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.2, f"no convergence: {losses[0]} -> {losses[-1]}"
+
+
+def test_mnist_cnn_converges():
+    np.random.seed(1)
+    img = fluid.layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    conv1 = fluid.nets.simple_img_conv_pool(
+        input=img, filter_size=5, num_filters=8, pool_size=2, pool_stride=2,
+        act="relu")
+    conv2 = fluid.nets.simple_img_conv_pool(
+        input=conv1, filter_size=5, num_filters=16, pool_size=2, pool_stride=2,
+        act="relu")
+    prediction = fluid.layers.fc(input=conv2, size=10, act="softmax")
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    opt = fluid.optimizer.Adam(learning_rate=0.01)
+    opt.minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    # learnable synthetic task: class = quadrant of a bright blob
+    def batch(n=64):
+        ys = np.random.randint(0, 10, size=(n, 1)).astype(np.int64)
+        xs = 0.1 * np.random.randn(n, 1, 28, 28).astype(np.float32)
+        for i in range(n):
+            c = int(ys[i, 0])
+            xs[i, 0, 2 * c: 2 * c + 4, 2 * c: 2 * c + 4] += 2.0
+        return xs, ys
+
+    first = last = None
+    for i in range(60):
+        xs, ys = batch()
+        loss, a = exe.run(feed={"img": xs, "label": ys},
+                          fetch_list=[avg_cost, acc])
+        if first is None:
+            first = float(loss)
+        last, last_acc = float(loss), float(a)
+    assert last < first * 0.5, f"no convergence: {first} -> {last}"
+    assert last_acc > 0.5
+
+
+def test_program_serialization_roundtrip():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    h = fluid.layers.fc(input=x, size=8, act="relu")
+    out = fluid.layers.fc(input=h, size=2, act="softmax")
+    prog = fluid.default_main_program()
+    s = prog.serialize_to_string()
+    prog2 = fluid.Program.parse_from_string(s)
+    assert [op.type for op in prog2.global_block().ops] == \
+        [op.type for op in prog.global_block().ops]
+    assert prog2.global_block().var(out.name).shape == out.shape
