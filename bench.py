@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 ImageNet training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: the best ResNet-50 training number published in the reference repo —
+84.08 images/sec (CPU MKL-DNN bs256, reference
+benchmark/IntelOptimizedPaddle.md:41-45; no GPU ResNet-50 number is
+published in-tree, see BASELINE.md).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+BASELINE_IMG_PER_SEC = 84.08
+
+
+def main():
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    batch_size = int(os.environ.get("BENCH_BATCH", "64"))
+    steps = int(os.environ.get("BENCH_STEPS", "30"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+
+    feeds, fetches = models.resnet.build(class_dim=1000, depth=50,
+                                         image_shape=(3, 224, 224))
+    loss = fetches["loss"]
+    opt = fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+    opt.minimize(loss)
+
+    exe = fluid.Executor(fluid.TPUPlace(0), amp=os.environ.get("BENCH_AMP", "1") == "1")
+    exe.run(fluid.default_startup_program())
+
+    # Pre-stage a few batches on device and cycle them — the AsyncFeeder
+    # double-buffer pattern. (This dev environment reaches the chip through a
+    # ~40 MB/s tunnel; production hosts overlap H2D with compute, which
+    # AsyncFeeder provides.)
+    import jax
+    rng = np.random.RandomState(0)
+    batches = []
+    for _ in range(4):
+        batches.append({
+            "image": jax.device_put(rng.rand(batch_size, 3, 224, 224)
+                                    .astype(np.float32)),
+            "label": jax.device_put(rng.randint(0, 1000, (batch_size, 1))
+                                    .astype(np.int32)),
+        })
+
+    for i in range(warmup):
+        exe.run(feed=batches[i % 4], fetch_list=[loss])
+    # force completion of warmup before timing
+    np.asarray(exe.run(feed=batches[0], fetch_list=[loss])[0])
+
+    t0 = time.perf_counter()
+    out = None
+    for i in range(steps):
+        out = exe.run(feed=batches[i % 4], fetch_list=[loss], return_numpy=False)
+    np.asarray(out[0])  # sync
+    dt = time.perf_counter() - t0
+
+    ips = batch_size * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / BASELINE_IMG_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -29,6 +29,9 @@ from . import io  # noqa: F401
 from . import profiler  # noqa: F401
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
+from .async_feeder import AsyncFeeder  # noqa: F401
+from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
 from .parallel.parallel_executor import (ParallelExecutor,  # noqa: F401
                                          BuildStrategy, ExecutionStrategy)
 from . import backward  # noqa: F401

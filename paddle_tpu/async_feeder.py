@@ -1,0 +1,96 @@
+"""Asynchronous input pipeline: the reference's py_reader / double_buffer
+analog (reference: python/paddle/fluid/layers/io.py:449 `py_reader`,
+operators/reader/create_double_buffer_reader_op.cc,
+reader/lod_tensor_blocking_queue.h).
+
+TPU-native redesign: a background thread pulls batches from a python reader,
+converts via DataFeeder, and pre-transfers them to device (`jax.device_put`),
+keeping a bounded queue full so each training step's H2D copy overlaps the
+previous step's compute — the double-buffer property. No in-graph reader ops
+are needed because feeds enter the jitted step as arguments.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, Iterable, Optional
+
+import jax
+
+
+class AsyncFeeder:
+    """`for feed in AsyncFeeder(feeder, reader, capacity=4): exe.run(feed=feed)`
+
+    feeder: DataFeeder (or any fn batch->feed dict); reader: batched reader
+    (yields lists of samples). device/sharding: optional placement applied
+    ahead of the step (ParallelExecutor passes its batch sharding).
+    """
+
+    def __init__(self, feeder, reader: Callable[[], Iterable], capacity: int = 4,
+                 device=None, sharding=None, pad_to: int = 0):
+        self._feeder = feeder
+        self._reader = reader
+        self._capacity = capacity
+        self._device = device
+        self._sharding = sharding
+        self._pad_to = pad_to
+
+    def _convert(self, batch) -> Dict:
+        feed = (self._feeder.feed(batch, pad_to=self._pad_to)
+                if hasattr(self._feeder, "feed") else self._feeder(batch))
+        target = self._sharding or self._device
+        if target is not None:
+            out = {}
+            for k, v in feed.items():
+                if isinstance(v, tuple):
+                    out[k] = tuple(jax.device_put(x, target) for x in v)
+                else:
+                    out[k] = jax.device_put(v, target)
+            return out
+        return feed
+
+    def __iter__(self):
+        q: queue.Queue = queue.Queue(maxsize=self._capacity)
+        end = object()
+        err = []
+        stop = threading.Event()
+
+        def producer():
+            try:
+                for batch in self._reader():
+                    item = self._convert(batch)
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return  # consumer abandoned the iteration
+            except Exception as e:  # surface reader errors on the consumer
+                err.append(e)
+            finally:
+                try:
+                    q.put_nowait(end)
+                except queue.Full:
+                    pass
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is end:
+                    break
+                yield item
+        finally:
+            # on break/close: release the producer and drop buffered batches
+            stop.set()
+            while not q.empty():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+        if err:
+            raise err[0]

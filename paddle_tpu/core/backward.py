@@ -64,6 +64,12 @@ def append_backward(loss: ir.Variable,
         out_has_grad = any(n in contribs for ns in op.outputs.values() for n in ns)
         if not out_has_grad:
             continue
+        if op.type == "while":
+            raise NotImplementedError(
+                "gradients cannot flow through a `while` loop on TPU "
+                "(lax.while_loop is not reverse-differentiable); express the "
+                "recurrence with layers.StaticRNN / dynamic_lstm / dynamic_gru "
+                "(lax.scan-based), or mark the loop outputs stop_gradient")
         grad_targets = _grad_needing_inputs(block, op, no_grad, parameter_list)
         if not grad_targets:
             continue

@@ -119,6 +119,10 @@ def global_scope() -> Scope:
 
 
 def _as_feed_array(v, var: Optional[ir.Variable]):
+    if isinstance(v, jax.Array):
+        # already on device (e.g. AsyncFeeder pre-transfer) — never round-trip
+        # through host
+        return v
     arr = np.asarray(v)
     if var is not None and var.dtype and arr.dtype != jnp.dtype(var.dtype):
         # Follow the reference DataFeeder's implicit cast for python scalars.
@@ -131,19 +135,22 @@ class _CompiledProgram:
     """One lowered+jitted step for a (program version, feed/fetch set)."""
 
     def __init__(self, program: ir.Program, feed_names, fetch_names, scope: Scope,
-                 donate: bool):
+                 donate: bool, amp: bool = False):
         self.program = program
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
         block = program.global_block()
-        lowerer = BlockLowerer(program)
+        lowerer = BlockLowerer(program, amp=amp)
 
         # Statically determine which scope vars the block reads/writes.
         written: List[str] = []
         produced = set(self.feed_names)
         read: List[str] = []
         for op in block.ops:
-            for n in op.input_arg_names:
+            in_names = list(op.input_arg_names)
+            for si in ir.sub_block_indices(op):
+                in_names += ir.external_reads(program, si)
+            for n in in_names:
                 if n == registry.EMPTY_VAR:
                     continue
                 if n not in produced and n not in read:
@@ -152,6 +159,9 @@ class _CompiledProgram:
                 if n == registry.EMPTY_VAR:
                     continue
                 produced.add(n)
+                # runtime seqlen propagation (lowering.py) materializes the
+                # @SEQLEN companion of sequence outputs without an explicit op
+                produced.add(n + ir.SEQLEN_SUFFIX)
                 v = block._find_var_recursive(n)
                 if v is not None and v.persistable and n not in written:
                     written.append(n)
@@ -201,8 +211,9 @@ class Executor:
     matches the reference API. Programs are compiled on first run and cached.
     """
 
-    def __init__(self, place: Optional[Place] = None):
+    def __init__(self, place: Optional[Place] = None, amp: bool = False):
         self.place = place or TPUPlace(0)
+        self.amp = amp  # bf16 mixed precision (reference float16_transpiler analog)
         self._cache: Dict[tuple, _CompiledProgram] = {}
         self._run_counter = 0
 
@@ -233,12 +244,13 @@ class Executor:
                 feed_arrays[name] = _as_feed_array(val, var)
 
         cache_key = (id(program), program._version, tuple(sorted(feed_arrays)),
-                     tuple(fetch_names), id(scope))
+                     tuple(fetch_names), id(scope), self.amp)
         compiled = self._cache.get(cache_key) if use_program_cache else None
         if compiled is None:
             with jax.default_device(self.place.jax_device()):
                 compiled = _CompiledProgram(program, sorted(feed_arrays),
-                                            fetch_names, scope, donate=True)
+                                            fetch_names, scope, donate=True,
+                                            amp=self.amp)
             if use_program_cache:
                 self._cache[cache_key] = compiled
 

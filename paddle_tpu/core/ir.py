@@ -390,6 +390,36 @@ class Program:
         return "\n".join(lines)
 
 
+def sub_block_indices(op: Operator) -> List[int]:
+    """Block indices referenced by a control-flow op's attrs."""
+    out = []
+    for key in ("sub_block", "else_block"):
+        idx = op.attrs.get(key, -1)
+        if isinstance(idx, int) and idx >= 0:
+            out.append(idx)
+    return out
+
+
+def external_reads(program: "Program", block_idx: int) -> List[str]:
+    """Variable names a block (and its nested blocks) reads from enclosing
+    scopes: not block-local and not produced by an earlier op in the block.
+    Used by executors for state analysis and by control-flow layers to
+    declare data dependencies."""
+    block = program.blocks[block_idx]
+    produced: set = set()
+    reads: List[str] = []
+    for op in block.ops:
+        in_names = list(op.input_arg_names)
+        for si in sub_block_indices(op):
+            in_names += external_reads(program, si)
+        for n in in_names:
+            if n in produced or n in block.vars or n in reads:
+                continue
+            reads.append(n)
+        produced.update(op.output_arg_names)
+    return reads
+
+
 # ---------------------------------------------------------------------------
 # Default program singletons + guards (reference framework.py:1843-1959).
 # ---------------------------------------------------------------------------

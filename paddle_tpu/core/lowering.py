@@ -28,8 +28,11 @@ from .registry import EMPTY_VAR, FWD_OP_ATTR, GRAD_OP_SUFFIX, LoweringContext
 class BlockLowerer:
     """Lowers a Block's op list into a pure function over an env dict."""
 
-    def __init__(self, program: ir.Program):
+    def __init__(self, program: ir.Program, amp: bool = False):
         self.program = program
+        # bf16 mixed precision for MXU ops (registry.AMP_OPS); params stay
+        # fp32, accumulation is fp32 on the MXU.
+        self.amp = amp
 
     def run_block(self, block_idx: int, env: Dict[str, Any], key) -> Dict[str, Any]:
         """Execute all ops of `block_idx` on `env` (name -> jnp array),
@@ -48,7 +51,7 @@ class BlockLowerer:
         opdef = registry.get_op_def(op.type)
         op_key = jax.random.fold_in(key, _op_seed(op, op_idx)) if opdef.needs_rng else None
         ins = _gather_inputs(op.inputs, env, op.type)
-        ctx = LoweringContext(op.attrs, key=op_key, lowerer=self, op=op)
+        ctx = LoweringContext(op.attrs, key=op_key, lowerer=self, op=op, env=env)
         outs = registry.call_rule(opdef, ctx, ins)
         _scatter_outputs(op, outs, env)
         if opdef.propagate_seqlen:
@@ -90,9 +93,13 @@ class BlockLowerer:
 
         def fwd_fn(*vals):
             ins = {s: [env[n] for n in ns] for s, ns in fwd_inputs.items()}
-            for (slot, pos, _), v in zip(diff_entries, vals):
+            # control-flow rules read values through ctx.env, not slot args —
+            # patch a shadow env so perturbations flow through jax.vjp
+            env2 = dict(env)
+            for (slot, pos, name), v in zip(diff_entries, vals):
                 ins[slot][pos] = v
-            ctx = LoweringContext(fwd_attrs, key=op_key, lowerer=self)
+                env2[name] = v
+            ctx = LoweringContext(fwd_attrs, key=op_key, lowerer=self, env=env2)
             outs = registry.call_rule(opdef, ctx, ins)
             flat = []
             for slot, names in out_slots:

@@ -105,18 +105,35 @@ class LoweringContext:
     reference's per-op cuRAND states).
     """
 
-    def __init__(self, attrs: Dict[str, Any], key=None, lowerer=None, op=None):
+    def __init__(self, attrs: Dict[str, Any], key=None, lowerer=None, op=None,
+                 env=None):
         self.attrs = attrs
         self.key = key
         self.lowerer = lowerer   # BlockLowerer, for control-flow sub-blocks
         self.op = op
+        self.env = env           # live env dict (control-flow ops only)
 
     def attr(self, name: str, default=None):
         return self.attrs.get(name, default)
 
 
+# MXU-heavy ops that run in bf16 under AMP (reference analog:
+# paddle/contrib/float16/float16_transpiler.py rewrote programs to fp16;
+# here the cast happens at lowering so fwd and vjp-grad stay consistent).
+AMP_OPS = frozenset({"conv2d", "depthwise_conv2d", "conv2d_transpose", "mul",
+                     "matmul", "lstm", "gru", "fc"})
+
+
+def _amp_cast_in(v):
+    if hasattr(v, "dtype") and v.dtype == jnp.float32:
+        return v.astype(jnp.bfloat16)
+    return v
+
+
 def call_rule(opdef: OpDef, ctx: LoweringContext, ins_by_slot: Dict[str, List[Any]]):
     """Dispatch arrays to the rule per its signature; normalize outputs."""
+    amp = (ctx.lowerer is not None and getattr(ctx.lowerer, "amp", False)
+           and opdef.type in AMP_OPS)
     kwargs = {}
     for slot in opdef.input_slots:
         vals = ins_by_slot.get(slot)
@@ -124,13 +141,20 @@ def call_rule(opdef: OpDef, ctx: LoweringContext, ins_by_slot: Dict[str, List[An
             if slot not in opdef.optional_slots:
                 raise ValueError(f"op {opdef.type}: required input slot {slot!r} missing")
             continue
+        if amp:
+            vals = [_amp_cast_in(v) for v in vals]
         kwargs[slot] = vals[0] if len(vals) == 1 else list(vals)
     out = opdef.lower(ctx, **kwargs)
     if out is None:
         out = {}
     norm = {}
     for slot, v in out.items():
-        norm[slot] = list(v) if isinstance(v, (list, tuple)) else [v]
+        vs = list(v) if isinstance(v, (list, tuple)) else [v]
+        if amp:
+            vs = [x.astype(jnp.float32)
+                  if hasattr(x, "dtype") and x.dtype == jnp.bfloat16 else x
+                  for x in vs]
+        norm[slot] = vs
     return norm
 
 

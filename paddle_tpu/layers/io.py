@@ -16,7 +16,12 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
     """
     helper = LayerHelper("data", name=name)
     shape = list(shape)
-    if append_batch_size:
+    if lod_level > 0:
+        # padded variable-length layout: [batch, time, *feature]. The
+        # reference's packed LoD shape [sum_T, *feature] gains an explicit
+        # (dynamic) time dim on TPU.
+        shape = [-1, -1] + shape if append_batch_size else [-1] + shape
+    elif append_batch_size:
         shape = [-1] + shape
     block = helper.main_program.current_block()
     if name in block.vars:

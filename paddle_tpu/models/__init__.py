@@ -1,0 +1,11 @@
+"""Model zoo mirroring the reference benchmark configs
+(reference: benchmark/fluid/models/{mnist,resnet,vgg,stacked_dynamic_lstm,
+machine_translation}.py) plus Transformer-base and DeepFM (the BASELINE.json
+target workloads)."""
+
+from . import mnist  # noqa: F401
+from . import resnet  # noqa: F401
+from . import vgg  # noqa: F401
+from . import stacked_dynamic_lstm  # noqa: F401
+from . import transformer  # noqa: F401
+from . import deepfm  # noqa: F401

@@ -1,0 +1,140 @@
+"""Control-flow op lowerings: while / static_rnn / conditional_block.
+
+Capability parity with the reference's control ops (reference:
+paddle/fluid/operators/while_op.cc:36, recurrent_op.cc:222 (StepScopes :53),
+conditional_block_op.cc; python DSL python/paddle/fluid/layers/
+control_flow.py: While :654, StaticRNN :429, ConditionalBlock :1200).
+
+TPU-native redesign: the reference runs sub-blocks through a nested Executor
+with per-step scopes. Here a sub-block lowers to a pure function over its
+carried variables and becomes the body of `lax.while_loop` / `lax.scan` /
+`lax.cond` — no data-dependent Python control flow inside the compiled step,
+so the whole loop stays on-device with static shapes (XLA requirement).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+def _run_sub(lowerer, sub_idx, base_env, carry, key):
+    env2 = dict(base_env)
+    env2.update(carry)
+    lowerer.run_block(sub_idx, env2, key)
+    return env2
+
+
+@register_op("while", propagate_seqlen=False, needs_rng=True)
+def _while(ctx, X=None, Condition=None):
+    """attrs: sub_block (block idx), carry_vars (loop-state names incl. the
+    condition var). The sub-block must write the condition each iteration."""
+    lowerer = ctx.lowerer
+    env = ctx.env
+    sub_idx = ctx.attr("sub_block")
+    carry_names = list(ctx.attr("carry_vars"))
+    cond_name = ctx.attr("cond_var")
+    key = ctx.key if ctx.key is not None else jax.random.key(0)
+
+    init_carry = {n: env[n] for n in carry_names}
+    init_carry["__loop_t__"] = jnp.int32(0)
+
+    def cond_fn(carry):
+        return carry[cond_name].reshape(())
+
+    def body_fn(carry):
+        t = carry.pop("__loop_t__")
+        # distinct randomness per iteration for RNG ops in the body
+        step_key = jax.random.fold_in(key, t)
+        env2 = _run_sub(lowerer, sub_idx, env, carry, step_key)
+        out = {n: env2[n] for n in carry_names}
+        out["__loop_t__"] = t + 1
+        return out
+
+    final = lax.while_loop(cond_fn, body_fn, init_carry)
+    return {"Out": [final[n] for n in carry_names]}
+
+
+@register_op("static_rnn", propagate_seqlen=False, needs_rng=True)
+def _static_rnn(ctx, X=None):
+    """Scan a sub-block over the time axis.
+
+    attrs: sub_block; step_inputs [(outer_name, inner_name), ...] where outer
+    is [B, T, ...] sliced to [B, ...] per step; memories
+    [(inner_pre_name, inner_mem_name, init_name), ...] (reference StaticRNN
+    memory/update_memory); step_outputs [inner_name, ...] stacked to
+    [B, T, ...].
+    """
+    lowerer = ctx.lowerer
+    env = ctx.env
+    sub_idx = ctx.attr("sub_block")
+    step_inputs = [tuple(p) for p in ctx.attr("step_inputs")]
+    memories = [tuple(m) for m in ctx.attr("memories")]
+    step_outputs = list(ctx.attr("step_outputs"))
+    key = ctx.key if ctx.key is not None else jax.random.key(0)
+
+    xs = {inner: jnp.swapaxes(env[outer], 0, 1)  # [T, B, ...]
+          for outer, inner in step_inputs}
+    init_mems = {pre: env[init] for pre, mem, init in memories}
+    init_mems["__loop_t__"] = jnp.int32(0)
+
+    def body(carry, xt):
+        t = carry.pop("__loop_t__")
+        carry_in = dict(carry)
+        carry_in.update(xt)
+        step_key = jax.random.fold_in(key, t)  # fresh RNG per timestep
+        env2 = _run_sub(lowerer, sub_idx, env, carry_in, step_key)
+        new_carry = {pre: env2[mem] for pre, mem, init in memories}
+        new_carry["__loop_t__"] = t + 1
+        outs = tuple(env2[n] for n in step_outputs)
+        return new_carry, outs
+
+    _, stacked = lax.scan(body, init_mems, xs)
+    # stacked outputs come back [T, B, ...] -> [B, T, ...]
+    return {"Out": [jnp.swapaxes(s, 0, 1) for s in stacked]}
+
+
+@register_op("conditional_block", propagate_seqlen=False, needs_rng=True)
+def _conditional_block(ctx, Cond, X=None):
+    """attrs: sub_block, out_vars (written by the branch), else_block
+    (optional). Lowered to lax.cond; with no else branch the false path
+    returns the vars' current values (reference conditional_block_op.cc
+    skips the block). The layer declares prior out-var values + sub-block
+    externals under X so the executor materializes them in env."""
+    lowerer = ctx.lowerer
+    env = ctx.env
+    sub_idx = ctx.attr("sub_block")
+    else_idx = ctx.attr("else_block", -1)
+    out_names = list(ctx.attr("out_vars"))
+    key = ctx.key if ctx.key is not None else jax.random.key(0)
+
+    pred = Cond.reshape(()) if hasattr(Cond, "reshape") else Cond
+
+    def true_fn(_):
+        env2 = _run_sub(lowerer, sub_idx, env, {}, key)
+        return tuple(env2[n] for n in out_names)
+
+    def false_fn(_):
+        if else_idx >= 0:
+            env2 = _run_sub(lowerer, else_idx, env, {}, key)
+            return tuple(env2[n] for n in out_names)
+        missing = [n for n in out_names if n not in env]
+        if missing:
+            raise ValueError(
+                f"conditional_block out_vars {missing} have no prior value; "
+                f"assign them before the block or add an else branch")
+        return tuple(env[n] for n in out_names)
+
+    outs = lax.cond(pred, true_fn, false_fn, None)
+    return {"Out": list(outs)}
+
+
+@register_op("select_input", propagate_seqlen=False)
+def _select_input(ctx, X, Mask):
+    """Mask-select between branch results (IfElse merge)."""
+    xs = X if isinstance(X, list) else [X]
+    idx = Mask.reshape(()).astype(jnp.int32)
+    return {"Out": lax.switch(idx, [lambda x=x: x for x in xs])}

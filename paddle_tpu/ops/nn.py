@@ -40,9 +40,7 @@ def _conv2d(ctx, Input, Filter, Bias=None):
         rhs_dilation=dils,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=groups,
-        preferred_element_type=jnp.float32 if Input.dtype == jnp.bfloat16 else None,
     )
-    out = out.astype(Input.dtype)
     if Bias is not None:
         out = out + Bias.reshape((1, -1, 1, 1))
     return {"Output": out}

@@ -40,7 +40,7 @@ def _reverse_padded(x, seqlen):
         if x.ndim > 2 else jnp.take_along_axis(x, idx, axis=1)
 
 
-@register_op("lstm", propagate_seqlen=False)
+@register_op("lstm", propagate_seqlen=True)
 def _lstm(ctx, Input, Weight, Bias=None, H0=None, C0=None, SeqLen=None):
     """Input: [B, T, 4H] (x-projections), Weight: [H, 4H] recurrent,
     Bias: [1, 4H]. Outputs Hidden/Cell: [B, T, H]."""
@@ -86,7 +86,7 @@ def _lstm(ctx, Input, Weight, Bias=None, H0=None, C0=None, SeqLen=None):
     return {"Hidden": hidden, "Cell": cell}
 
 
-@register_op("gru", propagate_seqlen=False)
+@register_op("gru", propagate_seqlen=True)
 def _gru(ctx, Input, Weight, Bias=None, H0=None, SeqLen=None):
     """Input: [B, T, 3H] x-projections; Weight: [H, 3H] packed as
     [W_u | W_r | W_c]. Gate order (u, r, c)."""

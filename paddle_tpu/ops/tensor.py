@@ -263,6 +263,32 @@ def _sequence_mask(ctx, X):
     return {"Y": (rng[None, :] < X.reshape(-1, 1)).astype(dtype)}
 
 
+@register_op("causal_mask", propagate_seqlen=False)
+def _causal_mask(ctx):
+    """Additive upper-triangular attention mask, computed in-graph (constant-
+    folded by XLA) instead of shipping a T*T blob through the IR."""
+    t = int(ctx.attr("size"))
+    neg = ctx.attr("neg", -1e9)
+    row = jnp.arange(t)[:, None]
+    col = jnp.arange(t)[None, :]
+    mask = jnp.where(col > row, jnp.float32(neg), jnp.float32(0.0))
+    return {"Out": mask.reshape(1, 1, t, t)}
+
+
+@register_op("sinusoid_pos_encoding", propagate_seqlen=False)
+def _sinusoid_pos_encoding(ctx):
+    """Transformer sinusoidal position table [T, D], computed in-graph."""
+    t = int(ctx.attr("size"))
+    d = int(ctx.attr("d_model"))
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    i = jnp.arange(d, dtype=jnp.float32)[None, :]
+    angle = pos / jnp.power(10000.0, (2.0 * jnp.floor(i / 2.0)) / d)
+    even = jnp.sin(angle)
+    odd = jnp.cos(angle)
+    enc = jnp.where(jnp.arange(d)[None, :] % 2 == 0, even, odd)
+    return {"Out": enc}
+
+
 @register_op("uniform_random_batch_size_like", needs_rng=True)
 def _uniform_random_bsl(ctx, Input):
     shape = [int(s) for s in ctx.attr("shape")]

@@ -53,8 +53,10 @@ class BuildStrategy:
         self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
         self.gradient_scale_strategy = BuildStrategy.GradientScaleStrategy.CoeffNumDevice
         self.debug_graphviz_path = ""
-        # TPU extension: name-pattern -> PartitionSpec for model parallelism.
+        # TPU extensions: name-pattern -> PartitionSpec for model parallelism,
+        # and bf16 mixed precision for the MXU ops.
         self.sharding_rules = []
+        self.amp = False
 
 
 class ParallelExecutor:
@@ -93,6 +95,22 @@ class ParallelExecutor:
             self._scope.set_var(name, jax.device_put(val, sharding_for(name, val)))
 
     def _sharding_for_state(self, name, val):
+        # 1. Parameter-level annotations (ParamAttr.sharding, e.g. the
+        #    transformer's Megatron-style 'mp' specs).
+        var = self._program.global_block().vars.get(name)
+        spec = getattr(var, "sharding", None)
+        if spec:
+            names = set(self._mesh.axis_names)
+            spec = [s if (s in names) else None for s in spec]
+            shape = getattr(val, "shape", ())
+            ok = len(shape) == len(spec)
+            if ok:
+                for d, s in zip(shape, spec):
+                    if s is not None and d % self._mesh.shape[s] != 0:
+                        ok = False
+            if ok and any(s is not None for s in spec):
+                return NamedSharding(self._mesh, PartitionSpec(*spec))
+        # 2. BuildStrategy pattern rules.
         for pattern, spec in self._build_strategy.sharding_rules:
             if pattern in name:
                 return NamedSharding(self._mesh, PartitionSpec(*spec))
@@ -140,7 +158,8 @@ class ParallelExecutor:
         compiled = self._cache.get(key)
         if compiled is None:
             compiled = _CompiledProgram(self._program, sorted(feed_arrays),
-                                        fetch_names, self._scope, donate=True)
+                                        fetch_names, self._scope, donate=True,
+                                        amp=self._build_strategy.amp)
             self._cache[key] = compiled
 
         seed = self._program.random_seed if self._program.random_seed is not None else 0

@@ -1,0 +1,4 @@
+"""Reader composition toolkit (reference: python/paddle/reader/)."""
+
+from .decorator import (map_readers, shuffle, chain, compose, buffered,  # noqa: F401
+                        firstn, xmap_readers, cache, batch)

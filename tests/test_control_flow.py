@@ -1,0 +1,85 @@
+"""Control-flow: While -> lax.while_loop, StaticRNN -> lax.scan
+(reference tests: test_while_op.py, test_recurrent_op.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_while_loop_counts():
+    i = layers.fill_constant([1], "float32", 0.0)
+    limit = layers.fill_constant([1], "float32", 10.0)
+    acc = layers.fill_constant([1], "float32", 0.0)
+    cond = layers.less_than(i, limit)
+    w = layers.While(cond)
+    with w.block():
+        layers.assign(acc + i, acc)
+        layers.increment(i, 1.0)
+        layers.less_than(i, limit, cond=cond)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    out, iv = exe.run(fetch_list=[acc, i])
+    assert float(np.asarray(out)[0]) == 45.0  # 0+1+...+9
+    assert float(np.asarray(iv)[0]) == 10.0
+
+
+def test_static_rnn_matches_manual_accumulation():
+    x = layers.data(name="x", shape=[5, 3], dtype="float32")  # [B, T=5, D=3]
+    h0 = layers.fill_constant_batch_size_like(x, [-1, 3], "float32", 0.0)
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        xt = rnn.step_input(x)
+        h = rnn.memory(init=h0)
+        nh = layers.elementwise_add(h, xt)
+        rnn.update_memory(h, nh)
+        rnn.step_output(nh)
+    out = rnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs = np.random.randn(2, 5, 3).astype(np.float32)
+    res, = exe.run(feed={"x": xs}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(res), np.cumsum(xs, axis=1),
+                               rtol=1e-5)
+
+
+def test_static_rnn_grads_flow():
+    """Backward through a scan: trainable projection inside the step."""
+    x = layers.data(name="x", shape=[4, 3], dtype="float32")
+    h0 = layers.fill_constant_batch_size_like(x, [-1, 3], "float32", 0.0)
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        xt = rnn.step_input(x)
+        h = rnn.memory(init=h0)
+        nh = layers.fc(input=layers.elementwise_add(h, xt), size=3, act="tanh",
+                       bias_attr=False)
+        rnn.update_memory(h, nh)
+        rnn.step_output(nh)
+    out = rnn()
+    loss = layers.mean(out)
+    opt = fluid.optimizer.SGD(learning_rate=0.5)
+    opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs = np.random.randn(2, 4, 3).astype(np.float32)
+    w_name = fluid.default_main_program().global_block().all_parameters()[0].name
+    w_before = np.array(fluid.global_scope().find_var(w_name))
+    g, = exe.run(feed={"x": xs}, fetch_list=[w_name + "@GRAD"])
+    assert np.abs(np.asarray(g)).sum() > 0, "no grad flowed into scan weight"
+    w_after = np.array(fluid.global_scope().find_var(w_name))
+    assert not np.allclose(w_before, w_after), "SGD did not update scan weight"
+
+
+def test_switch_sets_value():
+    step = layers.fill_constant([1], "float32", 5.0)
+    lr = layers.fill_constant([1], "float32", 0.0)
+    warmup = layers.fill_constant([1], "float32", 10.0)
+    cond = layers.less_than(step, warmup)
+    sw = layers.Switch()
+    with sw.case(cond):
+        layers.assign(layers.fill_constant([1], "float32", 0.01), lr)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    out, = exe.run(fetch_list=[lr])
+    assert abs(float(np.asarray(out)[0]) - 0.01) < 1e-8
