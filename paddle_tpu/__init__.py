@@ -35,6 +35,13 @@ from . import dataset  # noqa: F401
 from .parallel.parallel_executor import (ParallelExecutor,  # noqa: F401
                                          BuildStrategy, ExecutionStrategy)
 from . import backward  # noqa: F401
+from . import transpiler  # noqa: F401
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
+from .transpiler import memory_optimize, release_memory, InferenceTranspiler  # noqa: F401
+from . import distributed  # noqa: F401
+from .trainer import (Trainer, Inferencer, CheckpointConfig,  # noqa: F401
+                      BeginEpochEvent, EndEpochEvent, BeginStepEvent,
+                      EndStepEvent, save_checkpoint, load_checkpoint)
 
 __version__ = "0.1.0"
 

@@ -88,6 +88,11 @@ class BlockLowerer:
         if not diff_entries:
             return
         diff_vals = [env[name] for _, _, name in diff_entries]
+        if fwd_attrs.get("__remat__"):
+            # memory_optimize marked this op: barrier the recompute inputs so
+            # XLA cannot CSE the backward's re-traced forward with the
+            # original — the activation is rematerialized, not kept in HBM
+            diff_vals = list(jax.lax.optimization_barrier(tuple(diff_vals)))
 
         out_slots = [(slot, names) for slot, names in fwd_outputs.items() if names]
 

@@ -1,0 +1,77 @@
+"""Multi-host runtime bootstrap.
+
+Capability parity with the reference's multi-node bootstrap: gen_nccl_id over
+gRPC (reference: paddle/fluid/operators/gen_nccl_id_op.cc:31-59,
+platform/nccl_helper.h:96-120 NCCLContextMap with num_trainers*places ranks)
+and the PADDLE_* role env protocol (reference: python/paddle/fluid/
+trainer.py:321-369).
+
+TPU-native redesign: `jax.distributed.initialize` performs the id-exchange/
+rendezvous over DCN (coordinator = trainer 0), after which `jax.devices()`
+spans every host's chips and a Mesh over them gives GSPMD collectives across
+ICI within a slice and DCN between slices. The PADDLE_* env variables are
+honored so reference launch scripts keep working.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def init(coordinator_address: Optional[str] = None,
+         num_processes: Optional[int] = None,
+         process_id: Optional[int] = None):
+    """Join the multi-host world. Defaults follow the reference env protocol:
+    PADDLE_TRAINER_ID -> process_id, PADDLE_TRAINERS -> num_processes,
+    PADDLE_TRAINER_ENDPOINTS (or PADDLE_PSERVER_IPS:port) -> coordinator =
+    first endpoint."""
+    global _initialized
+    if _initialized:
+        return
+    process_id = process_id if process_id is not None else \
+        int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    num_processes = num_processes if num_processes is not None else \
+        int(os.environ.get("PADDLE_TRAINERS", "1"))
+    if coordinator_address is None:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        coordinator_address = eps.split(",")[0] if eps else "127.0.0.1:8273"
+    if num_processes > 1:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    _initialized = True
+
+
+def get_world_size() -> int:
+    return jax.process_count()
+
+
+def get_rank() -> int:
+    return jax.process_index()
+
+
+def global_mesh(axis_names=("dp",), axis_sizes=None):
+    """Mesh over every device in the (multi-host) world — the NCCLContextMap
+    `num_trainers * places` world (reference nccl_helper.h:118)."""
+    from .parallel.mesh import make_mesh
+    devices = jax.devices()
+    if axis_sizes is None:
+        axis_sizes = [len(devices)]
+    return make_mesh(axis_sizes, axis_names, devices)
+
+
+def barrier():
+    """Host barrier (reference fetch_barrier/send_barrier analog)."""
+    if jax.process_count() > 1:
+        # effects a cross-host sync via a tiny all-reduce
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh = global_mesh()
+        x = jax.device_put(jnp.zeros(len(jax.devices())),
+                           NamedSharding(mesh, PartitionSpec("dp")))
+        jax.jit(lambda a: a.sum(), out_shardings=NamedSharding(mesh, PartitionSpec()))(x).block_until_ready()

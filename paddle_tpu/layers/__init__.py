@@ -7,6 +7,8 @@ from .tensor import (create_tensor, create_global_var, fill_constant,  # noqa: F
                      argmax, argmin, zeros, ones, reverse)
 from .ops import *  # noqa: F401,F403
 from .metric_op import accuracy, auc  # noqa: F401
+from .loss_layers import (nce, hsigmoid, linear_chain_crf,  # noqa: F401
+                          crf_decoding, warpctc, edit_distance)
 from .control_flow import (While, StaticRNN, Switch, increment,  # noqa: F401
                            less_than, equal, array_write, array_read)
 from . import learning_rate_scheduler  # noqa: F401

@@ -103,8 +103,9 @@ class StaticRNN:
     outs = rnn()                      # [B, T, H]
     """
 
-    def __init__(self, name=None):
+    def __init__(self, name=None, num_steps=None):
         self.helper = LayerHelper("static_rnn", name=name)
+        self.num_steps = num_steps  # for input-free (decode) loops
         self._step_inputs = []   # (outer_name, inner_name)
         self._memories = []      # (pre_name, mem_name, init_name)
         self._step_outputs = []  # inner names
@@ -172,7 +173,7 @@ class StaticRNN:
         for inner_name in self._step_outputs:
             inner = self._sub_block.vars.get(inner_name)
             shape = ((inner.shape[0], -1) + tuple(inner.shape[1:])
-                     if inner is not None else ())
+                     if inner is not None and inner.shape else ())
             out = self._parent_block.create_var(
                 name=f"{self.helper.name}.out_{len(outs)}",
                 shape=shape, dtype=inner.dtype if inner else "float32")
@@ -191,7 +192,8 @@ class StaticRNN:
             attrs={"sub_block": self._sub_block.idx,
                    "step_inputs": [list(p) for p in self._step_inputs],
                    "memories": [list(m) for m in self._memories],
-                   "step_outputs": list(self._step_outputs)})
+                   "step_outputs": list(self._step_outputs),
+                   "num_steps": self.num_steps or 0})
 
     def __call__(self):
         if len(self._outputs) == 1:

@@ -8,3 +8,5 @@ from . import rnn  # noqa: F401
 from . import sequence  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import control  # noqa: F401
+from . import beam  # noqa: F401
+from . import loss_extra  # noqa: F401

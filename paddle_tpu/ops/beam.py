@@ -1,0 +1,94 @@
+"""Beam-search op lowerings.
+
+Capability parity with the reference's LoD beam search (reference:
+paddle/fluid/operators/beam_search_op.cc, beam_search_decode_op.cc,
+python/paddle/fluid/layers/nn.py beam_search :2657).
+
+TPU-native redesign: the reference tracks variable-width beams in LoD
+tensors and prunes finished hypotheses dynamically. Here beams have a static
+width [B, beam] (standard TPU practice): finished beams are frozen by score
+masking, decode runs a fixed max_len scan, and `beam_backtrack` gathers the
+final sequences from the stacked (ids, parents) history — all static shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+NEG_INF = -1e9
+
+
+@register_op("tile_beam", propagate_seqlen=False)
+def _tile_beam(ctx, X):
+    """[B, ...] -> [B*beam, ...] repeating each row (beam-major compatible
+    with reshape([B, beam, ...])). Repeats the @SEQLEN companion too."""
+    k = ctx.attr("beam_size")
+    out = jnp.repeat(X, k, axis=0)
+    if ctx.env is not None and ctx.op is not None:
+        from ..core.ir import SEQLEN_SUFFIX
+        in_name = ctx.op.input("X")[0]
+        comp = ctx.env.get(in_name + SEQLEN_SUFFIX)
+        if comp is not None:
+            for out_name in ctx.op.output("Out"):
+                ctx.env[out_name + SEQLEN_SUFFIX] = jnp.repeat(comp, k, axis=0)
+    return {"Out": out}
+
+
+@register_op("beam_search_step", propagate_seqlen=False)
+def _beam_search_step(ctx, LogProbs, AccScores, Finished):
+    """One expansion step.
+
+    LogProbs: [B, beam, V] log-softmax of the next token; AccScores:
+    [B, beam]; Finished: [B, beam] (bool). Selects the global top `beam`
+    continuations per batch row. Finished beams emit only end_id with
+    unchanged score, so they survive unchanged (the reference keeps them in
+    the LoD prune set).
+    """
+    beam = ctx.attr("beam_size")
+    end_id = ctx.attr("end_id", 1)
+    B, K, V = LogProbs.shape
+    fin = Finished.astype(bool)
+
+    # finished beams: ONLY the end_id continuation stays live (score += 0);
+    # every other token must be -inf or a finished beam floods the top-k
+    cont = jnp.where(fin[..., None], NEG_INF, LogProbs)
+    end_col = jnp.full((B, K, V), NEG_INF, LogProbs.dtype).at[:, :, end_id].set(0.0)
+    scores = AccScores[..., None] + jnp.where(fin[..., None], end_col, cont)
+
+    flat = scores.reshape(B, K * V)
+    top_scores, top_idx = lax.top_k(flat, beam)       # [B, beam]
+    parent = (top_idx // V).astype(jnp.int32)
+    token = (top_idx % V).astype(jnp.int32)
+    parent_fin = jnp.take_along_axis(fin, parent, axis=1)
+    new_fin = jnp.logical_or(parent_fin, token == end_id)
+    return {"Ids": token, "Parents": parent, "AccScoresOut": top_scores,
+            "FinishedOut": new_fin}
+
+
+@register_op("beam_backtrack", propagate_seqlen=False)
+def _beam_backtrack(ctx, Ids, Parents, AccScores):
+    """Reconstruct sequences from stacked per-step selections
+    (reference beam_search_decode_op.cc).
+
+    Ids/Parents: [B, T, beam]; AccScores: [B, beam] final. Outputs
+    SentenceIds [B, beam, T] (ranked best-first) + SentenceScores [B, beam].
+    """
+    B, T, K = Ids.shape
+
+    def backstep(carry, t):
+        beam_idx = carry                                     # [B, K]
+        ids_t = jnp.take_along_axis(Ids[:, t], beam_idx, axis=1)
+        parents_t = jnp.take_along_axis(Parents[:, t], beam_idx, axis=1)
+        return parents_t, ids_t
+
+    init = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[None, :], (B, K))
+    _, rev = lax.scan(backstep, init, jnp.arange(T - 1, -1, -1))
+    seqs = jnp.flip(jnp.transpose(rev, (1, 2, 0)), axis=-1)  # [B, K, T]
+    order = jnp.argsort(-AccScores, axis=1).astype(jnp.int32)
+    seqs = jnp.take_along_axis(seqs, order[:, :, None], axis=1)
+    scores = jnp.take_along_axis(AccScores, order, axis=1)
+    return {"SentenceIds": seqs, "SentenceScores": scores}

@@ -84,7 +84,8 @@ def _static_rnn(ctx, X=None):
     def body(carry, xt):
         t = carry.pop("__loop_t__")
         carry_in = dict(carry)
-        carry_in.update(xt)
+        if xt is not None:
+            carry_in.update(xt)
         step_key = jax.random.fold_in(key, t)  # fresh RNG per timestep
         env2 = _run_sub(lowerer, sub_idx, env, carry_in, step_key)
         new_carry = {pre: env2[mem] for pre, mem, init in memories}
@@ -92,7 +93,15 @@ def _static_rnn(ctx, X=None):
         outs = tuple(env2[n] for n in step_outputs)
         return new_carry, outs
 
-    _, stacked = lax.scan(body, init_mems, xs)
+    if xs:
+        _, stacked = lax.scan(body, init_mems, xs)
+    else:  # input-free decode loop: length from attr
+        n = int(ctx.attr("num_steps") or 0)
+        if n <= 0:
+            raise ValueError(
+                "StaticRNN has no step_input and no positive num_steps — "
+                "pass StaticRNN(num_steps=...) for input-free decode loops")
+        _, stacked = lax.scan(body, init_mems, None, length=n)
     # stacked outputs come back [T, B, ...] -> [B, T, ...]
     return {"Out": [jnp.swapaxes(s, 0, 1) for s in stacked]}
 

@@ -263,6 +263,16 @@ def _sequence_mask(ctx, X):
     return {"Y": (rng[None, :] < X.reshape(-1, 1)).astype(dtype)}
 
 
+@register_op("batch_gather", propagate_seqlen=False)
+def _batch_gather(ctx, X, Index):
+    """Per-row gather along axis 1: X [B, K, ...], Index [B, K'] ->
+    [B, K', ...] (beam-search parent reordering)."""
+    idx = Index.astype(jnp.int32)
+    while idx.ndim < X.ndim:
+        idx = idx[..., None]
+    return {"Out": jnp.take_along_axis(X, idx, axis=1)}
+
+
 @register_op("causal_mask", propagate_seqlen=False)
 def _causal_mask(ctx):
     """Additive upper-triangular attention mask, computed in-graph (constant-

@@ -1,0 +1,271 @@
+"""High-level Trainer / checkpointing.
+
+Capability parity with reference python/paddle/fluid/trainer.py: event
+classes :38-92, `Trainer` :167 (builds train program from a train_func,
+transpiles from env, trains by executor or ParallelExecutor :439-529),
+`CheckpointConfig` :98 and the serial-dir checkpoint protocol
+(`save_checkpoint` :637 / `load_checkpoint` :737, `_SUCCESS` marker
+`_write_success` :1186, rotation `_scroll_delete` :1164).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from . import io as fluid_io
+from . import unique_name
+from .core import ir
+from .core.executor import Executor, Scope, TPUPlace, global_scope
+from .data_feeder import DataFeeder
+from .parallel.parallel_executor import BuildStrategy, ParallelExecutor
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    """reference trainer.py:98 — serial checkpoint dirs with rotation."""
+
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1, step_interval=10):
+        self.checkpoint_dir = checkpoint_dir or os.path.join(
+            os.getcwd(), "checkpoint")
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = max(epoch_interval, 1)
+        self.step_interval = max(step_interval, 1)
+        self.epoch_id = 0
+        self.step_id = 0
+        self.load_serial = None
+
+
+SERIAL_PREFIX = "checkpoint_"
+TRAINER_ARGS_NAME = "trainer_args.json"
+SUCCESS_MARK = "_SUCCESS"
+
+
+def _serial_dir(root, serial):
+    return os.path.join(root, f"{SERIAL_PREFIX}{serial}")
+
+
+def get_latest_checkpoint_serial(checkpoint_dir) -> int:
+    """Highest serial with a _SUCCESS marker (reference :1203)."""
+    if not checkpoint_dir or not os.path.isdir(checkpoint_dir):
+        return -1
+    best = -1
+    for name in os.listdir(checkpoint_dir):
+        if not name.startswith(SERIAL_PREFIX):
+            continue
+        try:
+            serial = int(name[len(SERIAL_PREFIX):])
+        except ValueError:
+            continue
+        if os.path.exists(os.path.join(checkpoint_dir, name, SUCCESS_MARK)):
+            best = max(best, serial)
+    return best
+
+
+def save_checkpoint(executor, checkpoint_dir, trainer_id, main_program,
+                    trainer_args=None, max_num_checkpoints=3, scope=None):
+    """Write a new serial dir: params + trainer args + _SUCCESS, then rotate
+    (reference :637, :1164, :1186)."""
+    import json
+    serial = get_latest_checkpoint_serial(checkpoint_dir) + 1
+    cur = _serial_dir(checkpoint_dir, serial)
+    os.makedirs(cur, exist_ok=True)
+    fluid_io.save_persistables(executor, cur, main_program, scope=scope)
+    if trainer_args is not None:
+        with open(os.path.join(cur, f"trainer_{trainer_id}_{TRAINER_ARGS_NAME}"),
+                  "w") as f:
+            json.dump(trainer_args, f)
+    with open(os.path.join(cur, SUCCESS_MARK), "w") as f:
+        f.write("")
+    # rotate old serials
+    serials = sorted(
+        int(n[len(SERIAL_PREFIX):]) for n in os.listdir(checkpoint_dir)
+        if n.startswith(SERIAL_PREFIX) and n[len(SERIAL_PREFIX):].isdigit())
+    for s in serials[: max(0, len(serials) - max_num_checkpoints)]:
+        shutil.rmtree(_serial_dir(checkpoint_dir, s), ignore_errors=True)
+    return serial
+
+
+def load_checkpoint(executor, checkpoint_dir, serial, main_program,
+                    trainer_id=0, scope=None):
+    """Restore params (+ returns trainer args if present) from a serial dir
+    (reference :737)."""
+    import json
+    if serial is None or serial < 0:
+        raise ValueError(f"no valid checkpoint serial: {serial}")
+    cur = _serial_dir(checkpoint_dir, serial)
+    if not os.path.exists(os.path.join(cur, SUCCESS_MARK)):
+        raise RuntimeError(f"checkpoint {cur} has no {SUCCESS_MARK} marker")
+    fluid_io.load_persistables(executor, cur, main_program, scope=scope)
+    args_path = os.path.join(cur, f"trainer_{trainer_id}_{TRAINER_ARGS_NAME}")
+    if os.path.exists(args_path):
+        with open(args_path) as f:
+            return json.load(f)
+    return None
+
+
+class Trainer:
+    """reference trainer.py:167.
+
+    train_func() -> (loss, [metrics...]) builds the model into the trainer's
+    programs; optimizer_func() -> Optimizer. parallel=True trains through
+    ParallelExecutor over the whole mesh.
+    """
+
+    def __init__(self, train_func: Callable, optimizer_func: Callable,
+                 param_path=None, place=None, parallel=False,
+                 checkpoint_config: Optional[CheckpointConfig] = None):
+        self.place = place or TPUPlace(0)
+        self.parallel = parallel
+        self.checkpoint_cfg = checkpoint_config
+        self.scope = Scope()
+        self.startup_program = ir.Program()
+        self.train_program = ir.Program()
+        with ir.program_guard(self.train_program, self.startup_program), \
+                unique_name.guard():
+            out = train_func()
+            if isinstance(out, (list, tuple)):
+                self.loss = out[0]
+                self.metrics = list(out[1]) if len(out) > 1 and \
+                    isinstance(out[1], (list, tuple)) else list(out[1:])
+            else:
+                self.loss = out
+                self.metrics = []
+            optimizer = optimizer_func()
+            optimizer.minimize(self.loss)
+        self.test_program = self.train_program.clone(for_test=True)
+
+        self.exe = Executor(self.place)
+        self.exe.run(self.startup_program, scope=self.scope)
+        if param_path:
+            fluid_io.load_persistables(self.exe, param_path,
+                                       self.train_program, scope=self.scope)
+        if self.checkpoint_cfg:
+            serial = get_latest_checkpoint_serial(
+                self.checkpoint_cfg.checkpoint_dir)
+            if serial >= 0:
+                args = load_checkpoint(self.exe,
+                                       self.checkpoint_cfg.checkpoint_dir,
+                                       serial, self.train_program,
+                                       scope=self.scope)
+                if args:
+                    self.checkpoint_cfg.epoch_id = args.get("epoch_id", 0)
+                    self.checkpoint_cfg.step_id = args.get("step_id", 0)
+        self._pe = None
+
+    def _executor_run(self, feed, fetch_list):
+        if self.parallel:
+            if self._pe is None:
+                self._pe = ParallelExecutor(main_program=self.train_program,
+                                            loss_name=self.loss.name,
+                                            scope=self.scope)
+            return self._pe.run(fetch_list=fetch_list, feed=feed)
+        return self.exe.run(self.train_program, feed=feed,
+                            fetch_list=fetch_list, scope=self.scope)
+
+    def train(self, num_epochs, event_handler=None, reader=None,
+              feed_order=None):
+        event_handler = event_handler or (lambda e: None)
+        feeder = DataFeeder(feed_order, program=self.train_program)
+        # resume the global step counter from the restored checkpoint so the
+        # save cadence and trainer_args don't regress after a restart
+        step = self.checkpoint_cfg.step_id if self.checkpoint_cfg else 0
+        start_epoch = self.checkpoint_cfg.epoch_id if self.checkpoint_cfg else 0
+        for epoch in range(start_epoch, num_epochs):
+            event_handler(BeginEpochEvent(epoch))
+            for batch in reader():
+                begin = BeginStepEvent(epoch, step)
+                event_handler(begin)
+                fetch = [self.loss] + self.metrics if begin.fetch_metrics else []
+                out = self._executor_run(feeder.feed(batch), fetch)
+                event_handler(EndStepEvent(epoch, step,
+                                           [np.asarray(o) for o in out]))
+                step += 1
+                if self.checkpoint_cfg and \
+                        step % self.checkpoint_cfg.step_interval == 0:
+                    save_checkpoint(
+                        self.exe, self.checkpoint_cfg.checkpoint_dir, 0,
+                        self.train_program,
+                        trainer_args={"epoch_id": epoch, "step_id": step},
+                        max_num_checkpoints=self.checkpoint_cfg.max_num_checkpoints,
+                        scope=self.scope)
+            event_handler(EndEpochEvent(epoch))
+
+    def test(self, reader, feed_order):
+        feeder = DataFeeder(feed_order, program=self.test_program)
+        totals = None
+        count = 0
+        for batch in reader():
+            out = self.exe.run(self.test_program, feed=feeder.feed(batch),
+                               fetch_list=[self.loss] + self.metrics,
+                               scope=self.scope)
+            vals = [float(np.asarray(o).reshape(-1)[0]) for o in out]
+            totals = vals if totals is None else [a + b for a, b in
+                                                 zip(totals, vals)]
+            count += 1
+        return [t / max(count, 1) for t in (totals or [])]
+
+    def save_params(self, param_path):
+        fluid_io.save_persistables(self.exe, param_path, self.train_program,
+                                   scope=self.scope)
+
+    def save_inference_model(self, param_path, feeded_var_names,
+                             target_var_indexs):
+        targets = [self.loss] if not target_var_indexs else \
+            [self.metrics[i] for i in target_var_indexs]
+        fluid_io.save_inference_model(param_path, feeded_var_names, targets,
+                                      self.exe, self.train_program,
+                                      scope=self.scope)
+
+    def stop(self):
+        pass
+
+
+class Inferencer:
+    """reference inferencer.py companion."""
+
+    def __init__(self, infer_func: Callable, param_path: str, place=None,
+                 parallel=False):
+        self.place = place or TPUPlace(0)
+        self.scope = Scope()
+        self.startup_program = ir.Program()
+        self.inference_program = ir.Program()
+        with ir.program_guard(self.inference_program, self.startup_program), \
+                unique_name.guard():
+            self.predict_var = infer_func()
+        self.exe = Executor(self.place)
+        self.exe.run(self.startup_program, scope=self.scope)
+        fluid_io.load_persistables(self.exe, param_path,
+                                   self.inference_program, scope=self.scope)
+        self.inference_program = self.inference_program.clone(for_test=True)
+
+    def infer(self, inputs):
+        return self.exe.run(self.inference_program, feed=inputs,
+                            fetch_list=[self.predict_var], scope=self.scope)

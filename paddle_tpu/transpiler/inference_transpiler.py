@@ -1,0 +1,61 @@
+"""Inference graph rewrites (reference:
+python/paddle/fluid/transpiler/inference_transpiler.py — `fuse_batch_norm`
+:107 folds BN into the preceding conv's weights/bias; fuse_relu_mkldnn :63).
+
+On TPU, XLA fuses BN math into the conv at compile time, so runtime speed
+does not depend on this pass; it still exists for (a) API parity, (b)
+shrinking saved inference models (BN params folded away), matching the
+reference's deployment story.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import ir
+from ..core.executor import global_scope
+
+
+class InferenceTranspiler:
+    def transpile(self, program: ir.Program, place=None, scope=None):
+        scope = scope or global_scope()
+        self._fuse_batch_norm(program, scope)
+        return program
+
+    def _fuse_batch_norm(self, program: ir.Program, scope):
+        """Fold conv2d -> batch_norm(is_test) pairs: W' = W * gamma/std,
+        b' = beta - gamma*mean/std (reference inference_transpiler.py:107)."""
+        block = program.global_block()
+        i = 0
+        fused = 0
+        while i < len(block.ops) - 1:
+            op = block.ops[i]
+            nxt = block.ops[i + 1]
+            if (op.type == "conv2d" and nxt.type == "batch_norm"
+                    and op.output("Output") and nxt.input("X")
+                    and op.output("Output")[0] == nxt.input("X")[0]):
+                w_name = op.input("Filter")[0]
+                scale = np.asarray(scope.find_var(nxt.input("Scale")[0]))
+                bias = np.asarray(scope.find_var(nxt.input("Bias")[0]))
+                mean = np.asarray(scope.find_var(nxt.input("Mean")[0]))
+                var = np.asarray(scope.find_var(nxt.input("Variance")[0]))
+                w = np.asarray(scope.find_var(w_name))
+                eps = nxt.attrs.get("epsilon", 1e-5)
+                std = np.sqrt(var + eps)
+                scope.set_var(w_name, w * (scale / std).reshape(-1, 1, 1, 1))
+                conv_bias = 0.0
+                if op.input("Bias"):
+                    conv_bias = np.asarray(scope.find_var(op.input("Bias")[0]))
+                new_bias = (conv_bias - mean) * scale / std + bias
+                bias_name = w_name + "@bn_folded_bias"
+                scope.set_var(bias_name, new_bias.astype(w.dtype))
+                block.create_var(name=bias_name, shape=list(new_bias.shape),
+                                 dtype=str(w.dtype), persistable=True)
+                # rewrite: conv gains Bias, bn output aliases conv output
+                op.inputs["Bias"] = [bias_name]
+                op.outputs["Output"] = [nxt.output("Y")[0]]
+                block.remove_op(i + 1)
+                fused += 1
+            i += 1
+        program._bump()
+        return fused

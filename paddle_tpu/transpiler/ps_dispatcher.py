@@ -1,0 +1,36 @@
+"""Parameter placement dispatchers (reference:
+python/paddle/fluid/transpiler/ps_dispatcher.py). On TPU these assign
+parameter shards to mesh slices instead of pserver endpoints."""
+
+from __future__ import annotations
+
+
+class PSDispatcher:
+    def __init__(self, eplist):
+        self._eps = list(eplist)
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class RoundRobin(PSDispatcher):
+    def dispatch(self, varlist):
+        out = []
+        for v in varlist:
+            out.append(self._eps[self._step % len(self._eps)])
+            self._step += 1
+        return out
+
+
+class HashName(PSDispatcher):
+    def dispatch(self, varlist):
+        return [self._eps[hash(v.name if hasattr(v, "name") else str(v))
+                          % len(self._eps)] for v in varlist]

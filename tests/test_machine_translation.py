@@ -1,0 +1,71 @@
+"""Attention seq2seq: train on the synthetic copy task, then beam-search
+decode (reference book test: test_machine_translation.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import models
+from paddle_tpu.dataset import wmt16
+
+
+def _batchify(samples, pad=1):
+    srcs, trgs, lbls = zip(*samples)
+    sl = np.array([len(s) for s in srcs], np.int32)
+    tmax = max(len(t) for t in trgs)
+    smax = max(len(s) for s in srcs)
+    src = np.full((len(samples), smax, 1), 0, np.int64)
+    trg = np.full((len(samples), tmax, 1), pad, np.int64)
+    lbl = np.full((len(samples), tmax, 1), pad, np.int64)
+    for i, (s, t, l) in enumerate(zip(srcs, trgs, lbls)):
+        src[i, :len(s), 0] = s
+        trg[i, :len(t), 0] = t
+        lbl[i, :len(l), 0] = l
+    return {"src_word": (src, sl), "trg_word": trg, "lbl_word": lbl}
+
+
+def test_seq2seq_trains_and_beam_decodes(tmp_path):
+    dict_size = 32
+    feeds, fetches = models.machine_translation.build(
+        dict_size=dict_size, emb_dim=32, hidden_dim=32)
+    loss = fetches["loss"]
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    reader = wmt16.train(dict_size, dict_size)
+    samples = list(reader())[:256]
+    first = last = None
+    for epoch in range(2):
+        for i in range(0, 64, 8):
+            feed = _batchify(samples[i: i + 8])
+            l, = exe.run(feed=feed, fetch_list=[loss])
+            l = float(np.asarray(l).reshape(-1)[0])
+            first = first if first is not None else l
+            last = l
+    assert np.isfinite(last)
+    assert last < first, f"seq2seq loss did not fall: {first} -> {last}"
+
+    # save params, then build the infer graph and beam-decode
+    fluid.io.save_persistables(exe, str(tmp_path))
+    infer_prog = fluid.Program()
+    infer_start = fluid.Program()
+    with fluid.program_guard(infer_prog, infer_start), fluid.unique_name.guard():
+        ifeeds, ifetches = models.machine_translation.build_infer(
+            dict_size=dict_size, emb_dim=32, hidden_dim=32, beam_size=4,
+            max_len=8)
+        scope = fluid.Scope()
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(infer_start, scope=scope)
+        fluid.io.load_persistables(exe2, str(tmp_path), infer_prog, scope=scope)
+        feed = _batchify(samples[:4])
+        ids, scores = exe2.run(infer_prog,
+                               feed={"src_word": feed["src_word"]},
+                               fetch_list=[ifetches["ids"], ifetches["scores"]],
+                               scope=scope)
+    ids = np.asarray(ids)
+    scores = np.asarray(scores)
+    assert ids.shape == (4, 4, 8)
+    # beams ranked best-first
+    assert (np.diff(scores, axis=1) <= 1e-5).all()
+    assert (ids >= 0).all() and (ids < dict_size).all()

@@ -1,0 +1,161 @@
+"""Structured/sampled losses vs brute-force references
+(reference tests: test_nce.py, test_hsigmoid_op.py,
+test_linear_chain_crf_op.py, test_crf_decoding_op.py, test_warpctc_op.py,
+test_edit_distance_op.py)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run_single(feeds, fetch, feed_vals):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    outs = exe.run(feed=feed_vals, fetch_list=fetch)
+    return [np.asarray(o) for o in outs]
+
+
+def test_linear_chain_crf_matches_brute_force():
+    B, T, N = 2, 3, 3
+    rng = np.random.RandomState(0)
+    emission = rng.randn(B, T, N).astype(np.float32)
+    trans_full = rng.randn(N + 2, N).astype(np.float32) * 0.3
+    labels = rng.randint(0, N, (B, T, 1)).astype(np.int64)
+    lens = np.array([3, 2], np.int32)
+
+    x = layers.data(name="em", shape=[N], dtype="float32", lod_level=1)
+    lbl = layers.data(name="lbl", shape=[1], dtype="int64", lod_level=1)
+    ll = layers.linear_chain_crf(x, lbl,
+                                 param_attr=fluid.ParamAttr(name="crf_w"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.global_scope().set_var("crf_w", trans_full)
+    out, = exe.run(feed={"em": (emission, lens), "lbl": (labels, lens)},
+                   fetch_list=[ll])
+    nll = np.asarray(out).reshape(-1)
+
+    # brute force
+    start, stop, trans = trans_full[0], trans_full[1], trans_full[2:]
+    for b in range(B):
+        L = lens[b]
+        def score(path):
+            s = start[path[0]] + emission[b, 0, path[0]]
+            for t in range(1, L):
+                s += trans[path[t - 1], path[t]] + emission[b, t, path[t]]
+            return s + stop[path[-1]]
+        logz = np.log(sum(np.exp(score(p))
+                          for p in itertools.product(range(N), repeat=L)))
+        gold = score([int(labels[b, t, 0]) for t in range(L)])
+        np.testing.assert_allclose(nll[b], logz - gold, rtol=1e-4, atol=1e-4)
+
+
+def test_crf_decoding_matches_brute_force():
+    B, T, N = 2, 4, 3
+    rng = np.random.RandomState(1)
+    emission = rng.randn(B, T, N).astype(np.float32)
+    trans_full = rng.randn(N + 2, N).astype(np.float32) * 0.5
+    lens = np.array([4, 3], np.int32)
+
+    x = layers.data(name="em", shape=[N], dtype="float32", lod_level=1)
+    ll = layers.linear_chain_crf(x, layers.data(name="lbl", shape=[1],
+                                                dtype="int64", lod_level=1),
+                                 param_attr=fluid.ParamAttr(name="crf_w"))
+    path = layers.crf_decoding(x, param_attr=fluid.ParamAttr(name="crf_w"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.global_scope().set_var("crf_w", trans_full)
+    lbl_dummy = np.zeros((B, T, 1), np.int64)
+    out, = exe.run(feed={"em": (emission, lens), "lbl": (lbl_dummy, lens)},
+                   fetch_list=[path])
+    decoded = np.asarray(out)
+
+    start, stop, trans = trans_full[0], trans_full[1], trans_full[2:]
+    for b in range(B):
+        L = lens[b]
+        best, best_s = None, -1e30
+        for p in itertools.product(range(N), repeat=int(L)):
+            s = start[p[0]] + emission[b, 0, p[0]]
+            for t in range(1, L):
+                s += trans[p[t - 1], p[t]] + emission[b, t, p[t]]
+            s += stop[p[-1]]
+            if s > best_s:
+                best, best_s = p, s
+        assert tuple(decoded[b, :L]) == best, (b, decoded[b], best)
+
+
+def test_ctc_matches_brute_force():
+    B, T, C, U = 1, 4, 3, 2  # blank=0
+    rng = np.random.RandomState(2)
+    logits = rng.randn(B, T, C).astype(np.float32)
+    label = np.array([[1, 2]], np.int64)
+
+    x = layers.data(name="x", shape=[-1, T, C], dtype="float32",
+                    append_batch_size=False)
+    lbl = layers.data(name="lbl", shape=[-1, U], dtype="int64",
+                      append_batch_size=False)
+    loss = layers.warpctc(x, lbl, blank=0)
+    out, = _run_single(None, [loss], {"x": logits, "lbl": label})
+
+    # brute force: sum over all alignments collapsing to [1, 2]
+    logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+
+    def collapse(seq):
+        out_, prev = [], None
+        for s in seq:
+            if s != 0 and s != prev:
+                out_.append(s)
+            prev = s
+        return out_
+
+    total = 0.0
+    for seq in itertools.product(range(C), repeat=T):
+        if collapse(seq) == [1, 2]:
+            total += np.exp(sum(logp[0, t, s] for t, s in enumerate(seq)))
+    np.testing.assert_allclose(float(out.reshape(-1)[0]), -np.log(total),
+                               rtol=1e-4)
+
+
+def test_edit_distance():
+    hyp = np.array([[1, 2, 3, 4], [1, 1, 0, 0]], np.int64)
+    ref = np.array([[1, 3, 3, 0], [2, 2, 0, 0]], np.int64)
+    hl = np.array([4, 2], np.int32)
+    rl = np.array([3, 2], np.int32)
+
+    x = layers.data(name="hyp", shape=[1], dtype="int64", lod_level=1)
+    y = layers.data(name="ref", shape=[1], dtype="int64", lod_level=1)
+    dist, _ = layers.edit_distance(x, y, normalized=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    out, = exe.run(feed={"hyp": (hyp[..., None], hl), "ref": (ref[..., None], rl)},
+                   fetch_list=[dist])
+    got = np.asarray(out).reshape(-1)
+    # [1,2,3,4] vs [1,3,3]: sub 2->3, del 4 => 2 ; [1,1] vs [2,2]: 2 subs
+    np.testing.assert_allclose(got, [2.0, 2.0])
+
+
+def test_nce_and_hsigmoid_train():
+    rng = np.random.RandomState(3)
+    x = layers.data(name="x", shape=[8], dtype="float32")
+    lbl = layers.data(name="y", shape=[1], dtype="int64")
+    h = layers.fc(input=x, size=16, act="relu")
+    cost_nce = layers.nce(input=h, label=lbl, num_total_classes=20,
+                          num_neg_samples=5)
+    cost_hs = layers.hsigmoid(input=h, label=lbl, num_classes=20)
+    loss = layers.mean(cost_nce) + layers.mean(cost_hs)
+    loss = layers.mean(loss)
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    first = last = None
+    for i in range(30):
+        xs = rng.randn(32, 8).astype(np.float32)
+        ys = (np.abs(xs.sum(1)) * 3 % 20).astype(np.int64).reshape(-1, 1)
+        l, = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+        l = float(np.asarray(l).reshape(-1)[0])
+        first = first if first is not None else l
+        last = l
+    assert np.isfinite(last) and last < first

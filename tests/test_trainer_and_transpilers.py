@@ -1,0 +1,120 @@
+"""High-level Trainer, checkpoint rotation, transpilers
+(reference tests: test_checkpoint.py, test_memory_optimization_transpiler.py,
+test_inference_model_io.py, test_dist_transpiler.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _reader():
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 1).astype(np.float32)
+
+    def r():
+        for _ in range(8):
+            batch = []
+            for _ in range(16):
+                x = rng.randn(4).astype(np.float32)
+                batch.append((x, x @ w))
+            yield batch
+
+    return r
+
+
+def _train_func():
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    pred = layers.fc(input=x, size=1)
+    return layers.mean(layers.square_error_cost(input=pred, label=y))
+
+
+def test_trainer_events_and_checkpoint_rotation(tmp_path):
+    ckpt_dir = str(tmp_path / "ckpt")
+    cfg = fluid.CheckpointConfig(checkpoint_dir=ckpt_dir,
+                                 max_num_checkpoints=2, step_interval=3)
+    events = []
+    trainer = fluid.Trainer(
+        train_func=_train_func,
+        optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.05),
+        place=fluid.CPUPlace(), checkpoint_config=cfg)
+    losses = []
+
+    def handler(e):
+        events.append(type(e).__name__)
+        if isinstance(e, fluid.EndStepEvent):
+            losses.append(float(e.metrics[0].reshape(-1)[0]))
+
+    trainer.train(num_epochs=2, event_handler=handler, reader=_reader(),
+                  feed_order=["x", "y"])
+    assert losses[-1] < losses[0]
+    assert "BeginEpochEvent" in events and "EndStepEvent" in events
+    # rotation: at most 2 serial dirs, all with _SUCCESS
+    serials = [d for d in os.listdir(ckpt_dir) if d.startswith("checkpoint_")]
+    assert 0 < len(serials) <= 2
+    for s in serials:
+        assert os.path.exists(os.path.join(ckpt_dir, s, "_SUCCESS"))
+
+    # resume: a fresh trainer picks up the checkpoint + trainer args
+    trainer2 = fluid.Trainer(
+        train_func=_train_func,
+        optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.05),
+        place=fluid.CPUPlace(), checkpoint_config=cfg)
+    assert trainer2.checkpoint_cfg.step_id > 0
+
+
+def test_memory_optimize_marks_and_trains():
+    x = layers.data(name="x", shape=[8], dtype="float32")
+    h = layers.fc(input=x, size=16, act="relu")
+    h = layers.fc(input=h, size=16, act="tanh")
+    loss = layers.mean(layers.fc(input=h, size=1))
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    prog = fluid.default_main_program()
+    fluid.memory_optimize(prog)
+    marked = [op for blk in prog.blocks for op in blk.ops
+              if op.attrs.get("__remat__")]
+    assert marked, "memory_optimize marked nothing"
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    out, = exe.run(feed={"x": np.ones((4, 8), np.float32)}, fetch_list=[loss])
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_inference_transpiler_folds_bn():
+    x = layers.data(name="x", shape=[3, 8, 8], dtype="float32")
+    y = layers.batch_norm(layers.conv2d(x, 4, 3, padding=1, bias_attr=False,
+                                        act=None), is_test=True)
+    prog = fluid.default_main_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs = np.random.randn(2, 3, 8, 8).astype(np.float32)
+    test_prog = prog.clone(for_test=True)
+    before, = exe.run(test_prog, feed={"x": xs}, fetch_list=[y])
+
+    t = fluid.InferenceTranspiler()
+    t.transpile(test_prog, scope=fluid.global_scope())
+    types = [op.type for op in test_prog.global_block().ops]
+    assert "batch_norm" not in types, types
+    after, = exe.run(test_prog, feed={"x": xs}, fetch_list=[y])
+    np.testing.assert_allclose(np.asarray(before), np.asarray(after),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_distribute_transpiler_annotates_embeddings():
+    ids = layers.data(name="ids", shape=[1], dtype="int64")
+    emb = layers.embedding(ids, size=[200_000, 8],
+                           param_attr=fluid.ParamAttr(name="big_table"))
+    loss = layers.mean(emb)
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, trainers=4)
+    prog = t.get_trainer_program()
+    w = prog.global_block().vars["big_table"]
+    assert w.sharding == ("mp", None)
+    with pytest.raises(NotImplementedError):
+        t.get_pserver_program("127.0.0.1:6174")
+    with pytest.raises(NotImplementedError):
+        fluid.DistributeTranspiler().transpile(0, sync_mode=False)
