@@ -10,3 +10,4 @@ from . import optimizer_ops  # noqa: F401
 from . import control  # noqa: F401
 from . import beam  # noqa: F401
 from . import loss_extra  # noqa: F401
+from . import pallas_attention  # noqa: F401

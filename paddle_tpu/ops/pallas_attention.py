@@ -1,0 +1,211 @@
+"""Fused (flash) attention: Pallas TPU kernel + ring-attention building block.
+
+The reference's only attention is an unfused softmax(QK^T)V composition
+(reference: python/paddle/fluid/nets.py:329 scaled_dot_product_attention).
+TPU-native redesign: a Pallas kernel streams K/V blocks through VMEM with an
+online-softmax accumulator, so the [T, T] score matrix never materializes in
+HBM — O(T) memory instead of O(T^2), which is what makes long-context
+training feasible. Falls back to a pure-jnp path off-TPU / for odd shapes.
+
+Backward currently recomputes attention via the jnp reference under
+custom_vjp (correct; the dedicated backward kernel is a planned
+optimization).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+BLK_Q = 128
+BLK_K = 128
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# reference jnp implementation (used off-TPU and for the backward pass)
+# ---------------------------------------------------------------------------
+
+def _attention_reference(q, k, v, causal, sm_scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        row = jnp.arange(Tq)[:, None]
+        col = jnp.arange(Tk)[None, :]
+        s = jnp.where(col > row, NEG_INF, s)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# pallas kernel
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, blk_k):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    T = k_ref.shape[1]
+    D = q_ref.shape[2]
+    nblk = T // blk_k
+
+    q = q_ref[0].astype(jnp.float32) * sm_scale        # [BLK_Q, D]
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.dslice(j * blk_k, blk_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(j * blk_k, blk_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            row = qi * BLK_Q + jax.lax.broadcasted_iota(jnp.int32,
+                                                        (BLK_Q, blk_k), 0)
+            col = j * blk_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                       (BLK_Q, blk_k), 1)
+            s = jnp.where(col > row, NEG_INF, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((BLK_Q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((BLK_Q,), jnp.float32)
+    acc0 = jnp.zeros((BLK_Q, D), jnp.float32)
+    if causal:
+        hi = (qi * BLK_Q) // blk_k + (BLK_Q + blk_k - 1) // blk_k
+        hi = jnp.minimum(hi, nblk)
+    else:
+        hi = nblk
+    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-20)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, sm_scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, T, D = q.shape
+    q3 = q.reshape(B * H, T, D)
+    k3 = k.reshape(B * H, T, D)
+    v3 = v.reshape(B * H, T, D)
+    grid = (B * H, T // BLK_Q)
+    kernel = functools.partial(_flash_kernel, sm_scale=sm_scale,
+                               causal=causal, blk_k=BLK_K)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, BLK_Q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, BLK_Q, D), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+    )(q3, k3, v3)
+    return out.reshape(B, H, T, D)
+
+
+def _pallas_ok(q):
+    if jax.default_backend() == "cpu":
+        return False
+    B, H, T, D = q.shape
+    return T % BLK_Q == 0 and T % BLK_K == 0 and D <= 256
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal=False, sm_scale=1.0):
+    if _pallas_ok(q):
+        return _flash_forward(q, k, v, causal, sm_scale)
+    return _attention_reference(q, k, v, causal, sm_scale)
+
+
+def _fa_fwd(q, k, v, causal, sm_scale):
+    return flash_attention(q, k, v, causal, sm_scale), (q, k, v)
+
+
+def _fa_bwd(causal, sm_scale, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: _attention_reference(a, b, c, causal,
+                                                          sm_scale), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+@register_op("fused_attention", propagate_seqlen=False)
+def _fused_attention(ctx, Q, K, V):
+    """Q/K/V: [B, H, T, Dh]. attrs: causal, sm_scale."""
+    sm_scale = ctx.attr("sm_scale", 1.0 / math.sqrt(Q.shape[-1]))
+    causal = ctx.attr("causal", False)
+    return {"Out": flash_attention(Q, K, V, causal, sm_scale)}
+
+
+# ---------------------------------------------------------------------------
+# ring attention: sequence parallelism over an 'sp' mesh axis
+# ---------------------------------------------------------------------------
+
+def ring_attention(q, k, v, mesh, axis="sp", causal=False, sm_scale=None):
+    """Exact attention with Q/K/V sequence-sharded over `axis`.
+
+    Each device holds a [B, H, T/sp, D] shard; K/V shards rotate around the
+    ring via ppermute while a running online-softmax (m, l, acc) accumulates
+    — the Ring Attention algorithm. Communication rides ICI neighbor links;
+    peak memory per chip is O(T/sp). Built from differentiable jax ops
+    (ppermute has a transpose rule), so training works through it.
+
+    Exceeds reference capability: the reference has no sequence parallelism
+    (SURVEY.md §5.7).
+    """
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    sp = mesh.shape[axis]
+
+    def local(qs, ks, vs):
+        idx = lax.axis_index(axis)
+        Tl = qs.shape[2]
+
+        def block(carry, chunk_i):
+            m, l, acc, kc, vc = carry
+            # which global chunk do we currently hold?
+            src = (idx - chunk_i) % sp
+            s = jnp.einsum("bhqd,bhkd->bhqk", qs, kc).astype(jnp.float32) \
+                * sm_scale
+            if causal:
+                row = (idx * Tl + jnp.arange(Tl))[:, None]
+                col = (src * Tl + jnp.arange(Tl))[None, :]
+                s = jnp.where(col[None, None] > row[None, None], NEG_INF, s)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[..., None])
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            acc_new = acc * alpha[..., None] + jnp.einsum(
+                "bhqk,bhkd->bhqd", p.astype(vc.dtype), vc).astype(jnp.float32)
+            perm = [(i, (i + 1) % sp) for i in range(sp)]
+            kc = lax.ppermute(kc, axis, perm)
+            vc = lax.ppermute(vc, axis, perm)
+            return (m_new, l_new, acc_new, kc, vc), None
+
+        B, H, _, D = qs.shape
+        m0 = jnp.full((B, H, Tl), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((B, H, Tl), jnp.float32)
+        acc0 = jnp.zeros((B, H, Tl, D), jnp.float32)
+        (m, l, acc, _, _), _ = lax.scan(block, (m0, l0, acc0, ks, vs),
+                                        jnp.arange(sp))
+        return (acc / jnp.maximum(l, 1e-20)[..., None]).astype(qs.dtype)
+
+    spec = P(None, None, axis, None)
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_rep=False)(q, k, v)
