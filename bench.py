@@ -1,11 +1,15 @@
 #!/usr/bin/env python
-"""Headline benchmark: ResNet-50 ImageNet training throughput on one TPU chip.
+"""Headline benchmarks on one TPU chip, printed as ONE JSON line.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Primary metric: ResNet-50 ImageNet training throughput (NHWC, bf16 AMP).
 Baseline: the best ResNet-50 training number published in the reference repo —
 84.08 images/sec (CPU MKL-DNN bs256, reference
-benchmark/IntelOptimizedPaddle.md:41-45; no GPU ResNet-50 number is
-published in-tree, see BASELINE.md).
+benchmark/IntelOptimizedPaddle.md:41-45; no GPU ResNet-50 number is published
+in-tree, see BASELINE.md).
+
+`extra` carries the second BASELINE.json metric (Transformer-base WMT
+tokens/sec, seq 256) and a long-context Transformer run (seq 2048) through
+the Pallas flash-attention path.
 """
 
 import json
@@ -20,56 +24,105 @@ import numpy as np
 BASELINE_IMG_PER_SEC = 84.08
 
 
-def main():
-    import paddle_tpu as fluid
-    from paddle_tpu import models
+def _sync(x):
+    # axon's block_until_ready is a no-op; force with a host transfer
+    np.asarray(x)
 
-    batch_size = int(os.environ.get("BENCH_BATCH", "64"))
+
+def bench_resnet(fluid, models, jax):
+    batch_size = int(os.environ.get("BENCH_BATCH", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "30"))
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
 
-    feeds, fetches = models.resnet.build(class_dim=1000, depth=50,
-                                         image_shape=(3, 224, 224))
-    loss = fetches["loss"]
-    opt = fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
-    opt.minimize(loss)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        feeds, fetches = models.resnet.build(class_dim=1000, depth=50,
+                                             data_format="NHWC")
+        loss = fetches["loss"]
+        opt = fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+        opt.minimize(loss)
 
-    exe = fluid.Executor(fluid.TPUPlace(0), amp=os.environ.get("BENCH_AMP", "1") == "1")
-    exe.run(fluid.default_startup_program())
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0),
+                         amp=os.environ.get("BENCH_AMP", "1") == "1")
+    exe.run(startup, scope=scope)
 
-    # Pre-stage a few batches on device and cycle them — the AsyncFeeder
+    # Pre-stage batches on device and cycle them — the AsyncFeeder
     # double-buffer pattern. (This dev environment reaches the chip through a
     # ~40 MB/s tunnel; production hosts overlap H2D with compute, which
     # AsyncFeeder provides.)
-    import jax
     rng = np.random.RandomState(0)
     batches = []
     for _ in range(4):
         batches.append({
-            "image": jax.device_put(rng.rand(batch_size, 3, 224, 224)
+            "image": jax.device_put(rng.rand(batch_size, 224, 224, 3)
                                     .astype(np.float32)),
             "label": jax.device_put(rng.randint(0, 1000, (batch_size, 1))
                                     .astype(np.int32)),
         })
 
     for i in range(warmup):
-        exe.run(feed=batches[i % 4], fetch_list=[loss])
-    # force completion of warmup before timing
-    np.asarray(exe.run(feed=batches[0], fetch_list=[loss])[0])
+        out = exe.run(main, feed=batches[i % 4], fetch_list=[loss],
+                      return_numpy=False, scope=scope)
+    _sync(out[0])
 
     t0 = time.perf_counter()
-    out = None
     for i in range(steps):
-        out = exe.run(feed=batches[i % 4], fetch_list=[loss], return_numpy=False)
-    np.asarray(out[0])  # sync
+        out = exe.run(main, feed=batches[i % 4], fetch_list=[loss],
+                      return_numpy=False, scope=scope)
+    _sync(out[0])
     dt = time.perf_counter() - t0
+    return batch_size * steps / dt
 
-    ips = batch_size * steps / dt
+
+def bench_transformer(fluid, models, jax, seq_len, batch_size, fused,
+                      steps=15, warmup=4):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        feeds, fetches = models.transformer.build(seq_len=seq_len,
+                                                  fused_attention=fused)
+        loss = fetches["loss"]
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0), amp=True)
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    batch = {k: jax.device_put(rng.randint(1, 30000, (batch_size, seq_len))
+                               .astype(np.int32))
+             for k in ("src_word", "trg_word", "lbl_word")}
+    for _ in range(warmup):
+        out = exe.run(main, feed=batch, fetch_list=[loss],
+                      return_numpy=False, scope=scope)
+    _sync(out[0])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = exe.run(main, feed=batch, fetch_list=[loss],
+                      return_numpy=False, scope=scope)
+    _sync(out[0])
+    dt = time.perf_counter() - t0
+    return batch_size * seq_len * steps / dt
+
+
+def main():
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    ips = bench_resnet(fluid, models, jax)
+    tok_base = bench_transformer(fluid, models, jax, seq_len=256,
+                                 batch_size=64, fused=False)
+    tok_long = bench_transformer(fluid, models, jax, seq_len=2048,
+                                 batch_size=8, fused=True, steps=8, warmup=3)
+
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(ips, 2),
         "unit": "images/sec",
         "vs_baseline": round(ips / BASELINE_IMG_PER_SEC, 2),
+        "extra": {
+            "transformer_base_wmt_tokens_per_sec": round(tok_base, 0),
+            "transformer_seq2048_flash_tokens_per_sec": round(tok_long, 0),
+        },
     }))
 
 

@@ -117,23 +117,39 @@ class LoweringContext:
         return self.attrs.get(name, default)
 
 
-# MXU-heavy ops that run in bf16 under AMP (reference analog:
-# paddle/contrib/float16/float16_transpiler.py rewrote programs to fp16;
-# here the cast happens at lowering so fwd and vjp-grad stay consistent).
-AMP_OPS = frozenset({"conv2d", "depthwise_conv2d", "conv2d_transpose", "mul",
-                     "matmul", "lstm", "gru", "fc"})
+# AMP policy (torch-autocast style; reference analog:
+# paddle/contrib/float16/float16_transpiler.py rewrote programs to fp16).
+# MXU-heavy ops cast f32 inputs to bf16 and KEEP bf16 outputs — activations
+# flow through the network in bf16 and never round-trip f32 in HBM (a cast
+# feeding a conv cannot fuse on TPU, so per-op up/down-casts cost a full
+# read+write of every activation).  Numerically sensitive ops upcast bf16
+# inputs to f32.  Everything else runs in whatever dtype reaches it; the
+# f32 master params are cast at their point of use, so the vjp delivers
+# f32 grads to the optimizer automatically.
+AMP_BF16_OPS = frozenset({"conv2d", "depthwise_conv2d", "conv2d_transpose",
+                          "mul", "matmul", "lstm", "gru", "fc",
+                          "fused_attention"})
+AMP_F32_OPS = frozenset({"softmax", "log_softmax", "cross_entropy",
+                         "softmax_with_cross_entropy",
+                         "sigmoid_cross_entropy_with_logits",
+                         "square_error_cost", "smooth_l1", "huber_loss",
+                         "mean", "reduce_mean", "nce", "hierarchical_sigmoid",
+                         "linear_chain_crf", "warpctc", "cos_sim"})
+# Back-compat alias (older tests/tools referenced AMP_OPS).
+AMP_OPS = AMP_BF16_OPS
 
 
-def _amp_cast_in(v):
-    if hasattr(v, "dtype") and v.dtype == jnp.float32:
-        return v.astype(jnp.bfloat16)
+def _cast_to(v, dt_from, dt_to):
+    if hasattr(v, "dtype") and v.dtype == dt_from:
+        return v.astype(dt_to)
     return v
 
 
 def call_rule(opdef: OpDef, ctx: LoweringContext, ins_by_slot: Dict[str, List[Any]]):
     """Dispatch arrays to the rule per its signature; normalize outputs."""
-    amp = (ctx.lowerer is not None and getattr(ctx.lowerer, "amp", False)
-           and opdef.type in AMP_OPS)
+    amp_on = ctx.lowerer is not None and getattr(ctx.lowerer, "amp", False)
+    to_bf16 = amp_on and opdef.type in AMP_BF16_OPS
+    to_f32 = amp_on and opdef.type in AMP_F32_OPS
     kwargs = {}
     for slot in opdef.input_slots:
         vals = ins_by_slot.get(slot)
@@ -141,21 +157,16 @@ def call_rule(opdef: OpDef, ctx: LoweringContext, ins_by_slot: Dict[str, List[An
             if slot not in opdef.optional_slots:
                 raise ValueError(f"op {opdef.type}: required input slot {slot!r} missing")
             continue
-        if amp:
-            vals = [_amp_cast_in(v) for v in vals]
+        if to_bf16:
+            vals = [_cast_to(v, jnp.float32, jnp.bfloat16) for v in vals]
+        elif to_f32:
+            vals = [_cast_to(v, jnp.bfloat16, jnp.float32) for v in vals]
         kwargs[slot] = vals[0] if len(vals) == 1 else list(vals)
     out = opdef.lower(ctx, **kwargs)
     if out is None:
         out = {}
-    norm = {}
-    for slot, v in out.items():
-        vs = list(v) if isinstance(v, (list, tuple)) else [v]
-        if amp:
-            vs = [x.astype(jnp.float32)
-                  if hasattr(x, "dtype") and x.dtype == jnp.bfloat16 else x
-                  for x in vs]
-        norm[slot] = vs
-    return norm
+    return {slot: (list(v) if isinstance(v, (list, tuple)) else [v])
+            for slot, v in out.items()}
 
 
 # ---------------------------------------------------------------------------

@@ -163,12 +163,15 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
-           act=None, name=None):
-    """2-D convolution, NCHW (reference nn.py:1365). `use_cudnn` is accepted
-    for API parity and ignored — XLA owns kernel selection on TPU."""
+           act=None, name=None, data_format="NCHW"):
+    """2-D convolution, NCHW or NHWC (reference nn.py:1365). `use_cudnn` is
+    accepted for API parity and ignored — XLA owns kernel selection on TPU.
+    On TPU prefer data_format="NHWC": it matches the native conv layout and
+    avoids relayout transposes. Filters are stored OIHW either way."""
     helper = LayerHelper("conv2d", **locals())
     dtype = input.dtype
-    num_channels = input.shape[1]
+    c_axis = 1 if data_format == "NCHW" else len(input.shape) - 1
+    num_channels = input.shape[c_axis]
     fsize = filter_size if isinstance(filter_size, (list, tuple)) \
         else [filter_size, filter_size]
     filter_shape = [num_filters, num_channels // groups] + list(fsize)
@@ -180,8 +183,9 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
                      inputs={"Input": [input.name], "Filter": [w.name]},
                      outputs={"Output": [pre_bias.name]},
                      attrs={"strides": _pair(stride), "paddings": _pair(padding),
-                            "dilations": _pair(dilation), "groups": groups})
-    pre_act = _append_bias_channel(helper, pre_bias)
+                            "dilations": _pair(dilation), "groups": groups,
+                            "data_format": data_format})
+    pre_act = _append_bias_channel(helper, pre_bias, axis=c_axis)
     return helper.append_activation(pre_act)
 
 
@@ -189,16 +193,16 @@ def _pair(v):
     return list(v) if isinstance(v, (list, tuple)) else [v, v]
 
 
-def _append_bias_channel(helper, input_var):
+def _append_bias_channel(helper, input_var, axis=1):
     battr = helper.bias_attr
     if battr is False:
         return input_var
-    size = input_var.shape[1] if len(input_var.shape) > 1 else 1
+    size = input_var.shape[axis] if len(input_var.shape) > axis else 1
     b = helper.create_parameter(battr, [size], input_var.dtype, is_bias=True)
     out = helper.create_variable_for_type_inference(dtype=input_var.dtype)
     helper.append_op("elementwise_add",
                      inputs={"X": [input_var.name], "Y": [b.name]},
-                     outputs={"Out": [out.name]}, attrs={"axis": 1})
+                     outputs={"Out": [out.name]}, attrs={"axis": axis})
     return out
 
 
@@ -224,7 +228,7 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
            global_pooling=False, use_cudnn=True, ceil_mode=False,
-           exclusive=True, name=None):
+           exclusive=True, name=None, data_format="NCHW"):
     helper = LayerHelper("pool2d", **locals())
     out = helper.create_variable_for_type_inference(dtype=input.dtype)
     helper.append_op("pool2d", inputs={"X": [input.name]},
@@ -233,7 +237,8 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
                             "strides": _pair(pool_stride),
                             "paddings": _pair(pool_padding),
                             "global_pooling": global_pooling,
-                            "exclusive": exclusive})
+                            "exclusive": exclusive,
+                            "data_format": data_format})
     return out
 
 

@@ -1,8 +1,11 @@
 """ResNet for ImageNet / cifar10 (reference: benchmark/fluid/models/resnet.py).
 
-The reference builds conv_bn_layer/shortcut/bottleneck blocks through the
-layers DSL; identical structure here — every op lowers to XLA and the whole
-step compiles into one fused TPU program (convs tile onto the MXU)."""
+The canonical topology is expressed through the layers DSL; every op lowers
+to XLA and the whole step compiles into one fused TPU program (convs tile
+onto the MXU).  ``data_format="NHWC"`` runs channels-last end-to-end, which
+matches the TPU's native conv layout and avoids relayout transposes — use it
+for training throughput; "NCHW" is kept for reference API parity.
+"""
 
 from __future__ import annotations
 
@@ -10,44 +13,57 @@ from .. import layers
 
 
 def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu",
-                  is_test=False):
+                  is_test=False, data_format="NCHW"):
     conv = layers.conv2d(input=input, num_filters=ch_out,
                          filter_size=filter_size, stride=stride,
-                         padding=padding, act=None, bias_attr=False)
-    return layers.batch_norm(input=conv, act=act, is_test=is_test)
+                         padding=padding, act=None, bias_attr=False,
+                         data_format=data_format)
+    return layers.batch_norm(input=conv, act=act, is_test=is_test,
+                             data_layout=data_format)
 
 
-def shortcut(input, ch_out, stride, is_test=False):
-    ch_in = input.shape[1]
-    if ch_in != ch_out:
+def shortcut(input, ch_out, stride, is_test=False, data_format="NCHW"):
+    c_axis = 1 if data_format == "NCHW" else len(input.shape) - 1
+    if input.shape[c_axis] != ch_out:
         return conv_bn_layer(input, ch_out, 1, stride, 0, act=None,
-                             is_test=is_test)
+                             is_test=is_test, data_format=data_format)
     return input
 
 
-def basicblock(input, ch_out, stride, is_test=False):
-    short = shortcut(input, ch_out, stride, is_test=is_test)
-    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, is_test=is_test)
-    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None, is_test=is_test)
+def basicblock(input, ch_out, stride, is_test=False, data_format="NCHW"):
+    short = shortcut(input, ch_out, stride, is_test=is_test,
+                     data_format=data_format)
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, is_test=is_test,
+                          data_format=data_format)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None, is_test=is_test,
+                          data_format=data_format)
     return layers.elementwise_add(short, conv2, act="relu")
 
 
-def bottleneck(input, ch_out, stride, is_test=False):
-    short = shortcut(input, ch_out * 4, stride, is_test=is_test)
-    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0, is_test=is_test)
-    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, is_test=is_test)
-    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None, is_test=is_test)
+def bottleneck(input, ch_out, stride, is_test=False, data_format="NCHW"):
+    short = shortcut(input, ch_out * 4, stride, is_test=is_test,
+                     data_format=data_format)
+    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0, is_test=is_test,
+                          data_format=data_format)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, is_test=is_test,
+                          data_format=data_format)
+    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None,
+                          is_test=is_test, data_format=data_format)
     return layers.elementwise_add(short, conv3, act="relu")
 
 
-def layer_warp(block_func, input, ch_out, count, stride, is_test=False):
-    res_out = block_func(input, ch_out, stride, is_test=is_test)
+def layer_warp(block_func, input, ch_out, count, stride, is_test=False,
+               data_format="NCHW"):
+    res_out = block_func(input, ch_out, stride, is_test=is_test,
+                         data_format=data_format)
     for _ in range(1, count):
-        res_out = block_func(res_out, ch_out, 1, is_test=is_test)
+        res_out = block_func(res_out, ch_out, 1, is_test=is_test,
+                             data_format=data_format)
     return res_out
 
 
-def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False):
+def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False,
+                    data_format="NCHW"):
     cfg = {18: ([2, 2, 2, 1], basicblock),
            34: ([3, 4, 6, 3], basicblock),
            50: ([3, 4, 6, 3], bottleneck),
@@ -55,38 +71,50 @@ def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False):
            152: ([3, 8, 36, 3], bottleneck)}
     stages, block_func = cfg[depth]
     conv1 = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2, padding=3,
-                          is_test=is_test)
+                          is_test=is_test, data_format=data_format)
     pool1 = layers.pool2d(input=conv1, pool_type="max", pool_size=3,
-                          pool_stride=2, pool_padding=1)
-    res1 = layer_warp(block_func, pool1, 64, stages[0], 1, is_test=is_test)
-    res2 = layer_warp(block_func, res1, 128, stages[1], 2, is_test=is_test)
-    res3 = layer_warp(block_func, res2, 256, stages[2], 2, is_test=is_test)
-    res4 = layer_warp(block_func, res3, 512, stages[3], 2, is_test=is_test)
+                          pool_stride=2, pool_padding=1,
+                          data_format=data_format)
+    res1 = layer_warp(block_func, pool1, 64, stages[0], 1, is_test=is_test,
+                      data_format=data_format)
+    res2 = layer_warp(block_func, res1, 128, stages[1], 2, is_test=is_test,
+                      data_format=data_format)
+    res3 = layer_warp(block_func, res2, 256, stages[2], 2, is_test=is_test,
+                      data_format=data_format)
+    res4 = layer_warp(block_func, res3, 512, stages[3], 2, is_test=is_test,
+                      data_format=data_format)
     pool2 = layers.pool2d(input=res4, pool_size=7, pool_type="avg",
-                          global_pooling=True)
+                          global_pooling=True, data_format=data_format)
     out = layers.fc(input=pool2, size=class_dim, act="softmax")
     return out
 
 
-def resnet_cifar10(input, class_dim=10, depth=32, is_test=False):
+def resnet_cifar10(input, class_dim=10, depth=32, is_test=False,
+                   data_format="NCHW"):
     assert (depth - 2) % 6 == 0
     n = (depth - 2) // 6
     conv1 = conv_bn_layer(input, ch_out=16, filter_size=3, stride=1, padding=1,
-                          is_test=is_test)
-    res1 = layer_warp(basicblock, conv1, 16, n, 1, is_test=is_test)
-    res2 = layer_warp(basicblock, res1, 32, n, 2, is_test=is_test)
-    res3 = layer_warp(basicblock, res2, 64, n, 2, is_test=is_test)
+                          is_test=is_test, data_format=data_format)
+    res1 = layer_warp(basicblock, conv1, 16, n, 1, is_test=is_test,
+                      data_format=data_format)
+    res2 = layer_warp(basicblock, res1, 32, n, 2, is_test=is_test,
+                      data_format=data_format)
+    res3 = layer_warp(basicblock, res2, 64, n, 2, is_test=is_test,
+                      data_format=data_format)
     pool = layers.pool2d(input=res3, pool_size=8, pool_type="avg",
-                         global_pooling=True)
+                         global_pooling=True, data_format=data_format)
     out = layers.fc(input=pool, size=class_dim, act="softmax")
     return out
 
 
-def build(class_dim=1000, depth=50, image_shape=(3, 224, 224), is_test=False):
+def build(class_dim=1000, depth=50, image_shape=(3, 224, 224), is_test=False,
+          data_format="NCHW"):
+    if data_format == "NHWC" and image_shape[0] in (1, 3):
+        image_shape = (image_shape[1], image_shape[2], image_shape[0])
     image = layers.data(name="image", shape=list(image_shape), dtype="float32")
     label = layers.data(name="label", shape=[1], dtype="int64")
     predict = resnet_imagenet(image, class_dim=class_dim, depth=depth,
-                              is_test=is_test)
+                              is_test=is_test, data_format=data_format)
     cost = layers.cross_entropy(input=predict, label=label)
     avg_cost = layers.mean(cost)
     acc = layers.accuracy(input=predict, label=label)

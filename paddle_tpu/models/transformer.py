@@ -41,8 +41,21 @@ def _pos_table(size, d_model):
     return out
 
 
+def _fused_attention(qh, kh, vh, d_head, causal, dropout_rate, is_test):
+    """Flash-attention op: one O(T)-memory Pallas kernel instead of the
+    matmul/softmax/dropout/matmul chain (in-kernel weight dropout)."""
+    helper = LayerHelper("fused_attention")
+    out = helper.create_variable_for_type_inference(dtype=qh.dtype)
+    helper.append_op("fused_attention",
+                     inputs={"Q": [qh.name], "K": [kh.name], "V": [vh.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"causal": causal, "sm_scale": d_head ** -0.5,
+                            "dropout_rate": dropout_rate, "is_test": is_test})
+    return out
+
+
 def multi_head_attention(q_in, kv_in, d_model, num_heads, dropout_rate=0.0,
-                         causal=False, is_test=False, name=""):
+                         causal=False, is_test=False, name="", fused=True):
     d_head = d_model // num_heads
     q = layers.fc(input=q_in, size=d_model, num_flatten_dims=2, bias_attr=False,
                   param_attr=_shard((None, "mp")), name=name + "_q")
@@ -56,16 +69,20 @@ def multi_head_attention(q_in, kv_in, d_model, num_heads, dropout_rate=0.0,
         return layers.transpose(r, perm=[0, 2, 1, 3])
 
     qh, kh, vh = split_heads(q), split_heads(k), split_heads(v)
-    scores = layers.matmul(qh, kh, transpose_y=True, alpha=d_head ** -0.5)
-    if causal:
-        mask_var = _causal_mask(scores.shape[-1])
-        scores = layers.elementwise_add(scores, mask_var)
-    weights = layers.softmax(scores)
-    if dropout_rate:
-        weights = layers.dropout(weights, dropout_prob=dropout_rate,
-                                 is_test=is_test,
-                                 dropout_implementation="upscale_in_train")
-    ctx = layers.matmul(weights, vh)
+    if fused:
+        ctx = _fused_attention(qh, kh, vh, d_head, causal, dropout_rate,
+                               is_test)
+    else:
+        scores = layers.matmul(qh, kh, transpose_y=True, alpha=d_head ** -0.5)
+        if causal:
+            mask_var = _causal_mask(scores.shape[-1])
+            scores = layers.elementwise_add(scores, mask_var)
+        weights = layers.softmax(scores)
+        if dropout_rate:
+            weights = layers.dropout(weights, dropout_prob=dropout_rate,
+                                     is_test=is_test,
+                                     dropout_implementation="upscale_in_train")
+        ctx = layers.matmul(weights, vh)
     ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
     merged = layers.reshape(ctx, shape=[0, 0, d_model])
     return layers.fc(input=merged, size=d_model, num_flatten_dims=2,
@@ -106,7 +123,8 @@ def _embed(ids, vocab_size, d_model, seq_len, dropout_rate, is_test, name):
 
 def transformer(src_vocab_size=30000, trg_vocab_size=30000, seq_len=256,
                 n_layer=6, n_head=8, d_model=512, d_inner=2048,
-                dropout_rate=0.1, is_test=False, label_smooth_eps=0.0):
+                dropout_rate=0.1, is_test=False, label_smooth_eps=0.0,
+                fused_attention=True):
     """Returns (feeds, fetches) for a teacher-forced training step.
     Sequences are bucketed/padded to the static `seq_len` (TPU-friendly
     static shapes; the reference padded per-batch via LoD)."""
@@ -121,7 +139,8 @@ def transformer(src_vocab_size=30000, trg_vocab_size=30000, seq_len=256,
                  is_test, "src_emb")
     for i in range(n_layer):
         attn = multi_head_attention(enc, enc, d_model, n_head, dropout_rate,
-                                    is_test=is_test, name=f"enc{i}_self")
+                                    is_test=is_test, name=f"enc{i}_self",
+                                    fused=fused_attention)
         enc = _add_norm(enc, attn, dropout_rate, is_test)
         f = ffn(enc, d_model, d_inner, dropout_rate, is_test, name=f"enc{i}")
         enc = _add_norm(enc, f, dropout_rate, is_test)
@@ -131,10 +150,12 @@ def transformer(src_vocab_size=30000, trg_vocab_size=30000, seq_len=256,
     for i in range(n_layer):
         self_attn = multi_head_attention(dec, dec, d_model, n_head,
                                          dropout_rate, causal=True,
-                                         is_test=is_test, name=f"dec{i}_self")
+                                         is_test=is_test, name=f"dec{i}_self",
+                                         fused=fused_attention)
         dec = _add_norm(dec, self_attn, dropout_rate, is_test)
         cross = multi_head_attention(dec, enc, d_model, n_head, dropout_rate,
-                                     is_test=is_test, name=f"dec{i}_cross")
+                                     is_test=is_test, name=f"dec{i}_cross",
+                                     fused=fused_attention)
         dec = _add_norm(dec, cross, dropout_rate, is_test)
         f = ffn(dec, d_model, d_inner, dropout_rate, is_test, name=f"dec{i}")
         dec = _add_norm(dec, f, dropout_rate, is_test)

@@ -28,28 +28,33 @@ def _pair(v, n=2):
 
 @register_op("conv2d", propagate_seqlen=False)
 def _conv2d(ctx, Input, Filter, Bias=None):
-    """NCHW conv (reference conv_op.cc). Filter is OIHW."""
+    """Conv in NCHW or NHWC (reference conv_op.cc `data_format`). Filter is
+    always stored OIHW so parameters are layout-independent; lax accepts the
+    mixed dimension_numbers and XLA picks physical layouts for the MXU."""
     strides = _pair(ctx.attr("strides", [1, 1]))
     pads = _pair(ctx.attr("paddings", [0, 0]))
     dils = _pair(ctx.attr("dilations", [1, 1]))
     groups = ctx.attr("groups", 1)
+    fmt = ctx.attr("data_format", "NCHW")
     out = lax.conv_general_dilated(
         Input, Filter,
         window_strides=strides,
         padding=[(pads[0], pads[0]), (pads[1], pads[1])],
         rhs_dilation=dils,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=(fmt, "OIHW", fmt),
         feature_group_count=groups,
     )
     if Bias is not None:
-        out = out + Bias.reshape((1, -1, 1, 1))
+        bshape = (1, -1, 1, 1) if fmt == "NCHW" else (1, 1, 1, -1)
+        out = out + Bias.reshape(bshape)
     return {"Output": out}
 
 
 @register_op("depthwise_conv2d", propagate_seqlen=False)
 def _depthwise_conv2d(ctx, Input, Filter, Bias=None):
     ctx.attrs = dict(ctx.attrs)
-    ctx.attrs["groups"] = Input.shape[1]
+    c_axis = 1 if ctx.attr("data_format", "NCHW") == "NCHW" else 3
+    ctx.attrs["groups"] = Input.shape[c_axis]
     return _conv2d(ctx, Input, Filter, Bias)
 
 
@@ -79,15 +84,22 @@ def _pool2d(ctx, X):
     ksize = _pair(ctx.attr("ksize", [2, 2]))
     strides = _pair(ctx.attr("strides", [1, 1]))
     pads = _pair(ctx.attr("paddings", [0, 0]))
+    fmt = ctx.attr("data_format", "NCHW")
+    spatial = (2, 3) if fmt == "NCHW" else (1, 2)
     if ctx.attr("global_pooling", False) or ctx.attr("adaptive", False):
         if ctx.attr("adaptive", False) and tuple(ctx.attr("ksize")) != (1, 1):
             raise NotImplementedError("adaptive pool2d only supports output 1x1")
         if ptype == "max":
-            return {"Out": jnp.max(X, axis=(2, 3), keepdims=True)}
-        return {"Out": jnp.mean(X, axis=(2, 3), keepdims=True)}
-    window = (1, 1) + ksize
-    strides4 = (1, 1) + strides
-    padcfg = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+            return {"Out": jnp.max(X, axis=spatial, keepdims=True)}
+        return {"Out": jnp.mean(X, axis=spatial, keepdims=True)}
+    if fmt == "NCHW":
+        window = (1, 1) + ksize
+        strides4 = (1, 1) + strides
+        padcfg = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    else:
+        window = (1,) + ksize + (1,)
+        strides4 = (1,) + strides + (1,)
+        padcfg = ((0, 0), (pads[0], pads[0]), (pads[1], pads[1]), (0, 0))
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(X.dtype, jnp.floating) else jnp.iinfo(X.dtype).min
         out = lax.reduce_window(X, init, lax.max, window, strides4, padcfg)

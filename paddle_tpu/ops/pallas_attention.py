@@ -1,38 +1,59 @@
-"""Fused (flash) attention: Pallas TPU kernel + ring-attention building block.
+"""Fused (flash) attention: Pallas TPU kernels + ring-attention building block.
 
 The reference's only attention is an unfused softmax(QK^T)V composition
 (reference: python/paddle/fluid/nets.py:329 scaled_dot_product_attention).
-TPU-native redesign: a Pallas kernel streams K/V blocks through VMEM with an
+TPU-native redesign: Pallas kernels stream K/V blocks through VMEM with an
 online-softmax accumulator, so the [T, T] score matrix never materializes in
-HBM — O(T) memory instead of O(T^2), which is what makes long-context
-training feasible. Falls back to a pure-jnp path off-TPU / for odd shapes.
+HBM — O(T) memory instead of O(T^2) in both forward AND backward (the
+backward kernels recompute attention weights from the saved logsumexp, the
+FlashAttention-2 scheme: one kernel for dQ gridded over query blocks, one for
+dK/dV gridded over key blocks).
 
-Backward currently recomputes attention via the jnp reference under
-custom_vjp (correct; the dedicated backward kernel is a planned
-optimization).
+Attention-weight dropout runs inside the kernel using the TPU PRNG
+(pltpu.prng_seed / prng_random_bits), re-seeded per (batch·head, q-block,
+k-block) so forward and both backward kernels regenerate identical masks in
+any iteration order.
+
+Off-TPU the same kernels run under the Pallas interpreter when
+PADDLE_TPU_PALLAS_INTERPRET=1 (used by the CPU test suite); otherwise a
+pure-jnp reference path takes over.
 """
 
 from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..core.registry import register_op
 
-BLK_Q = 128
-BLK_K = 128
 NEG_INF = -1e30
 
 
+def _blk(T):
+    """Block size: biggest power-of-two tile <= 256 dividing T. Larger tiles
+    amortize per-program overhead; 256x256 f32 scores tiles fit VMEM easily."""
+    for b in (256, 128):
+        if T % b == 0:
+            return b
+    raise ValueError(f"flash attention needs T % 128 == 0, got {T}")
+
+
+def _interpret():
+    return os.environ.get("PADDLE_TPU_PALLAS_INTERPRET", "0") == "1"
+
+
 # ---------------------------------------------------------------------------
-# reference jnp implementation (used off-TPU and for the backward pass)
+# reference jnp implementation (off-TPU fallback)
 # ---------------------------------------------------------------------------
 
-def _attention_reference(q, k, v, causal, sm_scale):
+def _attention_reference(q, k, v, causal, sm_scale, dropout_rate=0.0,
+                         seed=None):
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
     if causal:
         Tq, Tk = s.shape[-2], s.shape[-1]
@@ -40,114 +61,359 @@ def _attention_reference(q, k, v, causal, sm_scale):
         col = jnp.arange(Tk)[None, :]
         s = jnp.where(col > row, NEG_INF, s)
     p = jax.nn.softmax(s, axis=-1)
+    if dropout_rate:
+        key = jax.random.key(seed if seed is not None else 0)
+        keep = jax.random.bernoulli(key, 1.0 - dropout_rate, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
 # ---------------------------------------------------------------------------
-# pallas kernel
+# pallas kernels
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, blk_k):
+# multiplicative-hash constants (Knuth), expressed as python ints that fit
+# int32 so Mosaic folds them; applied in two rounds so adjacent tile indices
+# land on well-separated PRNG streams.
+_HASH_A = int(np.int32(np.uint32(2654435761)))
+_HASH_B = 40503
+
+
+def _dropout_mask(seed_ref, bh, qi, kj, shape, rate):
+    """Deterministic keep-mask for one (bh, q-block, k-block) tile. Re-seeding
+    per tile makes the mask independent of kernel iteration order, so the
+    forward, dQ and dK/dV kernels all regenerate the same mask."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    s = seed_ref[0, 0] * _HASH_A + bh * _HASH_B + qi
+    s = s * _HASH_A + kj
+    pltpu.prng_seed(s)
+    bits = pltpu.prng_random_bits(shape)  # uniform int32 over full range
+    # P(bits >= t) = 1 - rate  for t = -2^31 + rate * 2^32
+    thresh = int(min(max(-2**31 + rate * 2**32, -2**31), 2**31 - 1))
+    return bits >= jnp.int32(thresh)
+
+
+def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                      sm_scale, causal, blk_k, dropout_rate):
     from jax.experimental import pallas as pl
 
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     T = k_ref.shape[1]
     D = q_ref.shape[2]
+    blk_q = q_ref.shape[1]
     nblk = T // blk_k
 
-    q = q_ref[0].astype(jnp.float32) * sm_scale        # [BLK_Q, D]
+    q = q_ref[0].astype(jnp.float32) * sm_scale        # [blk_q, D]
 
     def body(j, carry):
         m, l, acc = carry
         k = k_ref[0, pl.dslice(j * blk_k, blk_k), :].astype(jnp.float32)
         v = v_ref[0, pl.dslice(j * blk_k, blk_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
         if causal:
-            row = qi * BLK_Q + jax.lax.broadcasted_iota(jnp.int32,
-                                                        (BLK_Q, blk_k), 0)
-            col = j * blk_k + jax.lax.broadcasted_iota(jnp.int32,
-                                                       (BLK_Q, blk_k), 1)
+            row = qi * blk_q + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+            col = j * blk_k + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
             s = jnp.where(col > row, NEG_INF, s)
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+        if dropout_rate:
+            keep = _dropout_mask(seed_ref, bh, qi, j, (blk_q, blk_k),
+                                 dropout_rate)
+            p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+        acc_new = acc * alpha[:, None] + lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
-    m0 = jnp.full((BLK_Q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((BLK_Q,), jnp.float32)
-    acc0 = jnp.zeros((BLK_Q, D), jnp.float32)
+    m0 = jnp.full((blk_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((blk_q,), jnp.float32)
+    acc0 = jnp.zeros((blk_q, D), jnp.float32)
     if causal:
-        hi = (qi * BLK_Q) // blk_k + (BLK_Q + blk_k - 1) // blk_k
+        hi = (qi * blk_q) // blk_k + (blk_q + blk_k - 1) // blk_k
         hi = jnp.minimum(hi, nblk)
     else:
         hi = nblk
-    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-20)[:, None]).astype(o_ref.dtype)
+    m, l, acc = lax.fori_loop(0, hi, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-20)
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0, pl.dslice(qi * blk_q, blk_q)] = m + jnp.log(l)
 
 
-def _flash_forward(q, k, v, causal, sm_scale):
+def _flash_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                     delta_ref, dq_ref, *, sm_scale, causal, blk_k,
+                     dropout_rate):
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    T = k_ref.shape[1]
+    blk_q = q_ref.shape[1]
+    nblk = T // blk_k
+
+    q = q_ref[0].astype(jnp.float32) * sm_scale
+    do = do_ref[0].astype(jnp.float32)                 # [blk_q, D]
+    lse = lse_ref[0, 0, pl.dslice(qi * blk_q, blk_q)]  # [blk_q]
+    delta = delta_ref[0, 0, pl.dslice(qi * blk_q, blk_q)]
+
+    def body(j, acc):
+        k = k_ref[0, pl.dslice(j * blk_k, blk_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(j * blk_k, blk_k), :].astype(jnp.float32)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        if causal:
+            row = qi * blk_q + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+            col = j * blk_k + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(col > row, NEG_INF, s)
+        w = jnp.exp(s - lse[:, None])                  # normalized weights
+        dpv = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+        if dropout_rate:
+            keep = _dropout_mask(seed_ref, bh, qi, j, (blk_q, blk_k),
+                                 dropout_rate)
+            dw = jnp.where(keep, dpv / (1.0 - dropout_rate), 0.0)
+        else:
+            dw = dpv
+        ds = w * (dw - delta[:, None])
+        return acc + lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+
+    if causal:
+        hi = (qi * blk_q) // blk_k + (blk_q + blk_k - 1) // blk_k
+        hi = jnp.minimum(hi, nblk)
+    else:
+        hi = nblk
+    acc0 = jnp.zeros((blk_q, q_ref.shape[2]), jnp.float32)
+    acc = lax.fori_loop(0, hi, body, acc0)
+    # s = sm_scale * (q . k)  =>  dq = sm_scale * ds @ k
+    dq_ref[0] = (acc * sm_scale).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                      delta_ref, dk_ref, dv_ref, *, sm_scale, causal, blk_q,
+                      dropout_rate):
+    from jax.experimental import pallas as pl
+
+    bh = pl.program_id(0)
+    kj = pl.program_id(1)
+    T = q_ref.shape[1]
+    D = q_ref.shape[2]
+    blk_k = k_ref.shape[1]
+    nblk = T // blk_q
+
+    k = k_ref[0].astype(jnp.float32)                   # [BLK_K, D]
+    v = v_ref[0].astype(jnp.float32)
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[0, pl.dslice(i * blk_q, blk_q), :].astype(jnp.float32) \
+            * sm_scale
+        do = do_ref[0, pl.dslice(i * blk_q, blk_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.dslice(i * blk_q, blk_q)]
+        delta = delta_ref[0, 0, pl.dslice(i * blk_q, blk_q)]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        if causal:
+            row = i * blk_q + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+            col = kj * blk_k + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(col > row, NEG_INF, s)
+        w = jnp.exp(s - lse[:, None])                  # [blk_q, BLK_K]
+        dpv = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+        if dropout_rate:
+            keep = _dropout_mask(seed_ref, bh, i, kj, (blk_q, blk_k),
+                                 dropout_rate)
+            w_drop = jnp.where(keep, w / (1.0 - dropout_rate), 0.0)
+            dw = jnp.where(keep, dpv / (1.0 - dropout_rate), 0.0)
+        else:
+            w_drop, dw = w, dpv
+        dv_new = dv_acc + lax.dot_general(
+            w_drop, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = w * (dw - delta[:, None])
+        dk_new = dk_acc + lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    if causal:
+        lo = (kj * blk_k) // blk_q
+    else:
+        lo = 0
+    z = jnp.zeros((blk_k, D), jnp.float32)
+    dk, dv = lax.fori_loop(lo, nblk, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)  # q pre-scaled => includes sm_scale
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _seed_arr(seed):
+    return jnp.asarray(seed, jnp.int32).reshape(1, 1)
+
+
+def _flash_forward(q, k, v, causal, sm_scale, dropout_rate=0.0, seed=0):
+    from jax.experimental import pallas as pl
 
     B, H, T, D = q.shape
+    BQ = BK = _blk(T)
     q3 = q.reshape(B * H, T, D)
     k3 = k.reshape(B * H, T, D)
     v3 = v.reshape(B * H, T, D)
-    grid = (B * H, T // BLK_Q)
-    kernel = functools.partial(_flash_kernel, sm_scale=sm_scale,
-                               causal=causal, blk_k=BLK_K)
-    out = pl.pallas_call(
+    grid = (B * H, T // BQ)
+    kernel = functools.partial(_flash_fwd_kernel, sm_scale=sm_scale,
+                               causal=causal, blk_k=BK,
+                               dropout_rate=dropout_rate)
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, BLK_Q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1), lambda bh, qi: (0, 0)),
+            pl.BlockSpec((1, BQ, D), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, BLK_Q, D), lambda bh, qi: (bh, qi, 0)),
+        out_specs=[
+            pl.BlockSpec((1, BQ, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, T), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, 1, T), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(_seed_arr(seed), q3, k3, v3)
+    return out.reshape(B, H, T, D), lse
+
+
+def _flash_backward(q, k, v, o, lse, g, causal, sm_scale, dropout_rate, seed):
+    from jax.experimental import pallas as pl
+
+    B, H, T, D = q.shape
+    q3, k3, v3 = (x.reshape(B * H, T, D) for x in (q, k, v))
+    o3 = o.reshape(B * H, T, D)
+    g3 = g.reshape(B * H, T, D)
+    delta = jnp.sum(g3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1)[:, None, :]
+
+    BQ = BK = _blk(T)
+    dq_kernel = functools.partial(_flash_dq_kernel, sm_scale=sm_scale,
+                                  causal=causal, blk_k=BK,
+                                  dropout_rate=dropout_rate)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B * H, T // BQ),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda bh, qi: (0, 0)),
+            pl.BlockSpec((1, BQ, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, BQ, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, T), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, T), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, BQ, D), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-    )(q3, k3, v3)
-    return out.reshape(B, H, T, D)
+        interpret=_interpret(),
+    )(_seed_arr(seed), q3, k3, v3, g3, lse, delta)
+
+    dkv_kernel = functools.partial(_flash_dkv_kernel, sm_scale=sm_scale,
+                                   causal=causal, blk_q=BQ,
+                                   dropout_rate=dropout_rate)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(B * H, T // BK),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda bh, kj: (0, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, kj: (bh, 0, 0)),
+            pl.BlockSpec((1, BK, D), lambda bh, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, BK, D), lambda bh, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, kj: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, T), lambda bh, kj: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, T), lambda bh, kj: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BK, D), lambda bh, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, BK, D), lambda bh, kj: (bh, kj, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, T, D), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(_seed_arr(seed), q3, k3, v3, g3, lse, delta)
+
+    return (dq.reshape(B, H, T, D), dk.reshape(B, H, T, D),
+            dv.reshape(B, H, T, D))
 
 
-def _pallas_ok(q):
-    if jax.default_backend() == "cpu":
+def _pallas_ok(q, dropout_rate=0.0):
+    if jax.default_backend() == "cpu" and not _interpret():
         return False
     B, H, T, D = q.shape
-    return T % BLK_Q == 0 and T % BLK_K == 0 and D <= 256
+    if _interpret() and dropout_rate:
+        return False  # pltpu.prng_* has no interpreter implementation
+    return T % 128 == 0 and D <= 256
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention(q, k, v, causal=False, sm_scale=1.0):
-    if _pallas_ok(q):
-        return _flash_forward(q, k, v, causal, sm_scale)
-    return _attention_reference(q, k, v, causal, sm_scale)
+# ---------------------------------------------------------------------------
+# public entry: custom_vjp so program autodiff gets the Pallas backward
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention(q, k, v, seed, causal=False, sm_scale=1.0,
+                    dropout_rate=0.0):
+    """seed: int32 scalar (traced) driving attention-weight dropout."""
+    if _pallas_ok(q, dropout_rate):
+        out, _ = _flash_forward(q, k, v, causal, sm_scale, dropout_rate, seed)
+        return out
+    return _attention_reference(q, k, v, causal, sm_scale, dropout_rate, seed)
 
 
-def _fa_fwd(q, k, v, causal, sm_scale):
-    return flash_attention(q, k, v, causal, sm_scale), (q, k, v)
+def _fa_fwd(q, k, v, seed, causal, sm_scale, dropout_rate):
+    if _pallas_ok(q, dropout_rate):
+        out, lse = _flash_forward(q, k, v, causal, sm_scale, dropout_rate,
+                                  seed)
+        return out, (q, k, v, out, lse, seed)
+    out = _attention_reference(q, k, v, causal, sm_scale, dropout_rate, seed)
+    return out, (q, k, v, None, None, seed)
 
 
-def _fa_bwd(causal, sm_scale, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: _attention_reference(a, b, c, causal,
-                                                          sm_scale), q, k, v)
-    return vjp(g)
+def _fa_bwd(causal, sm_scale, dropout_rate, res, g):
+    q, k, v, o, lse, seed = res
+    if o is not None:
+        dq, dk, dv = _flash_backward(q, k, v, o, lse, g, causal, sm_scale,
+                                     dropout_rate, seed)
+    else:
+        _, vjp = jax.vjp(
+            lambda a, b, c: _attention_reference(a, b, c, causal, sm_scale,
+                                                 dropout_rate, seed),
+            q, k, v)
+        dq, dk, dv = vjp(g)
+    dseed = np.zeros(jnp.shape(seed), jax.dtypes.float0)
+    return dq, dk, dv, dseed
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
 
 
-@register_op("fused_attention", propagate_seqlen=False)
+@register_op("fused_attention", propagate_seqlen=False, needs_rng=True)
 def _fused_attention(ctx, Q, K, V):
-    """Q/K/V: [B, H, T, Dh]. attrs: causal, sm_scale."""
+    """Q/K/V: [B, H, T, Dh]. attrs: causal, sm_scale, dropout_rate, is_test.
+
+    Replaces the reference's matmul+softmax+dropout+matmul composition
+    (nets.py:329) with one O(T)-memory kernel. Dropout is applied to the
+    attention weights inside the kernel, keyed from the executor's
+    functional PRNG."""
     sm_scale = ctx.attr("sm_scale", 1.0 / math.sqrt(Q.shape[-1]))
     causal = ctx.attr("causal", False)
-    return {"Out": flash_attention(Q, K, V, causal, sm_scale)}
+    rate = 0.0 if ctx.attr("is_test", False) else ctx.attr("dropout_rate", 0.0)
+    seed = jnp.uint32(0)
+    if rate and ctx.key is not None:
+        seed = jax.random.key_data(ctx.key).reshape(-1)[0]
+    return {"Out": flash_attention(Q, K, V, seed.astype(jnp.int32), causal,
+                                   sm_scale, float(rate))}
 
 
 # ---------------------------------------------------------------------------

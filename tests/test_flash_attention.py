@@ -1,0 +1,141 @@
+"""Flash-attention kernel + ring-attention tests.
+
+The Pallas kernels run under the Pallas interpreter on CPU
+(PADDLE_TPU_PALLAS_INTERPRET=1), so the actual kernel code — online softmax,
+causal block skipping, the FlashAttention-2 backward — is exercised by the
+CPU suite; the TPU hardware path is identical modulo Mosaic lowering.
+(In-kernel dropout uses the hardware PRNG, which has no interpreter
+implementation — covered by the jnp fallback-path test instead.)
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu import models
+from paddle_tpu.ops.pallas_attention import (flash_attention,
+                                             _attention_reference,
+                                             ring_attention)
+
+
+@pytest.fixture
+def interpret_kernels(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_kernel_matches_reference(interpret_kernels, causal):
+    rng = np.random.RandomState(0)
+    B, H, T, D = 1, 2, 256, 64
+    q, k, v = (jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+               for _ in range(3))
+    seed = jnp.int32(0)
+    out = flash_attention(q, k, v, seed, causal, D ** -0.5, 0.0)
+    ref = _attention_reference(q, k, v, causal, D ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_matches_reference(interpret_kernels, causal):
+    rng = np.random.RandomState(1)
+    B, H, T, D = 1, 2, 256, 64
+    q, k, v = (jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+               for _ in range(3))
+    g = jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+    seed = jnp.int32(0)
+
+    def f(q, k, v):
+        return (flash_attention(q, k, v, seed, causal, D ** -0.5, 0.0)
+                * g).sum()
+
+    def r(q, k, v):
+        return (_attention_reference(q, k, v, causal, D ** -0.5) * g).sum()
+
+    g1 = jax.grad(f, (0, 1, 2))(q, k, v)
+    g2 = jax.grad(r, (0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4,
+                                   rtol=1e-4, err_msg=f"d{name}")
+
+
+def test_flash_dropout_fallback_path():
+    """On CPU without interpret mode the jnp fallback handles dropout; the
+    output must be unbiased-ish and differentiable."""
+    rng = np.random.RandomState(2)
+    B, H, T, D = 2, 2, 128, 32
+    q, k, v = (jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+               for _ in range(3))
+    seed = jnp.int32(5)
+    out = flash_attention(q, k, v, seed, False, D ** -0.5, 0.5)
+    base = flash_attention(q, k, v, seed, False, D ** -0.5, 0.0)
+    assert np.isfinite(np.asarray(out)).all()
+    assert not np.allclose(np.asarray(out), np.asarray(base))
+    grads = jax.grad(
+        lambda q, k, v: flash_attention(q, k, v, seed, True, D ** -0.5,
+                                        0.1).sum(), (0, 1, 2))(q, k, v)
+    assert all(np.isfinite(np.asarray(x)).all() for x in grads)
+
+
+def test_ring_attention_matches_reference():
+    """Ring attention over an 8-way 'sp' mesh == exact attention."""
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    devices = jax.devices()
+    assert len(devices) >= 8
+    mesh = make_mesh([8], ["sp"], devices[:8])
+    rng = np.random.RandomState(3)
+    B, H, T, D = 2, 2, 64, 16
+    q, k, v = (jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+               for _ in range(3))
+    for causal in (False, True):
+        out = ring_attention(q, k, v, mesh, axis="sp", causal=causal)
+        ref = _attention_reference(q, k, v, causal, D ** -0.5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5,
+                                   err_msg=f"causal={causal}")
+
+
+def test_ring_attention_grad():
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh([4], ["sp"], jax.devices()[:4])
+    rng = np.random.RandomState(4)
+    B, H, T, D = 1, 2, 32, 16
+    q, k, v = (jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+               for _ in range(3))
+
+    g1 = jax.grad(lambda q, k, v: ring_attention(
+        q, k, v, mesh, axis="sp", causal=True).sum(), (0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: _attention_reference(
+        q, k, v, True, D ** -0.5).sum(), (0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4,
+                                   rtol=1e-4, err_msg=f"d{name}")
+
+
+def test_transformer_fused_attention_trains():
+    """The fused_attention op path through the program executor: loss drops
+    and stays finite over a few steps (CPU -> jnp fallback path)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        feeds, fetches = models.transformer.build(
+            src_vocab_size=64, trg_vocab_size=64, seq_len=16, n_layer=1,
+            n_head=2, d_model=32, d_inner=64, dropout_rate=0.1,
+            fused_attention=True)
+        loss = fetches["loss"]
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(10):
+        feed = {k: rng.randint(1, 64, (4, 16)).astype(np.int64)
+                for k in ("src_word", "trg_word", "lbl_word")}
+        out, = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(out).reshape(-1)[0]))
+    assert all(np.isfinite(l) for l in losses)
+    assert np.mean(losses[-3:]) < np.mean(losses[:3])
