@@ -53,40 +53,80 @@ def append_backward(loss: ir.Variable,
     )
 
     # 2. Reverse walk emitting grad ops; collect per-var grad contributions.
+    #
+    # Contributions are tracked in EPOCHS: programs are not strictly SSA (a
+    # `while` loop or `assign(x, out=y)` re-writes an existing name), and
+    # grad contributions to different SSA "versions" of a name must never be
+    # summed together. When the reverse walk passes an op that WRITES var n,
+    # n's current epoch closes and a fresh one opens; each epoch's
+    # contributions are summed separately into the canonical `n@GRAD` name,
+    # and ordered execution (grad ops run reverse-fwd) makes the canonical
+    # name hold the right epoch's value at every read point. (The reference
+    # gets the same effect with per-step scopes, while_op.cc:96.)
     loss_idx = _find_producer_idx(block, loss.name)
-    contribs: Dict[str, List[str]] = {loss.name: [loss_grad.name]}
+    # var -> list of epochs; each epoch is a list of (contrib_name, grad_op)
+    contribs: Dict[str, List[List[Tuple[str, Optional[ir.Operator]]]]] = {
+        loss.name: [[(loss_grad.name, block.ops[-1])]]}
+    rename_counter: Dict[str, int] = {}
     fwd_ops = list(enumerate(block.ops[: loss_idx + 1]))
-    grad_ops_meta = []  # (grad_op, [contributed var names])
 
-    for idx, op in reversed(fwd_ops):
-        if op.type in _NON_DIFF_OPS or op.type.endswith(GRAD_OP_SUFFIX):
-            continue
-        out_has_grad = any(n in contribs for ns in op.outputs.values() for n in ns)
-        if not out_has_grad:
-            continue
-        if op.type == "while":
-            raise NotImplementedError(
-                "gradients cannot flow through a `while` loop on TPU "
-                "(lax.while_loop is not reverse-differentiable); express the "
-                "recurrence with layers.StaticRNN / dynamic_lstm / dynamic_gru "
-                "(lax.scan-based), or mark the loop outputs stop_gradient")
-        grad_targets = _grad_needing_inputs(block, op, no_grad, parameter_list)
-        if not grad_targets:
-            continue
+    def _has_grad(n):
+        # only the CURRENT epoch's contributions are reachable by ops at this
+        # point of the reverse walk; earlier epochs belong to later SSA
+        # versions of the name (severed by a write barrier)
+        return n in contribs and bool(contribs[n][-1])
 
-        # out-grad inputs: canonical @GRAD names (finalized later by sum ops).
-        out_grad_names = []
+    def _write_barrier(op):
+        # this op produced these names; earlier consumers see the previous
+        # SSA version, so their grads start a new epoch. Applies to EVERY
+        # producing op — a non-diff op (fill_constant out=x) severs the
+        # dependency just as thoroughly as a diff one.
         for ns in op.outputs.values():
             for n in ns:
                 if n in contribs:
+                    contribs[n].append([])
+
+    for idx, op in reversed(fwd_ops):
+        if op.type.endswith(GRAD_OP_SUFFIX):
+            continue
+        if op.type in _NON_DIFF_OPS:
+            _write_barrier(op)
+            continue
+        out_has_grad = any(_has_grad(n) for ns in op.outputs.values() for n in ns)
+        if not out_has_grad:
+            _write_barrier(op)
+            continue
+        if op.type == "while":
+            raise NotImplementedError(
+                "gradients cannot flow through an unbounded `while` loop on "
+                "TPU (lax.while_loop is not reverse-differentiable); pass "
+                "While(cond, max_iters=N) for a scan-based differentiable "
+                "loop, or use layers.StaticRNN / DynamicRNN / dynamic_lstm")
+        grad_targets = _grad_needing_inputs(block, op, no_grad, parameter_list)
+
+        # out-grad inputs: canonical @GRAD names.
+        out_grad_names = []
+        for ns in op.outputs.values():
+            for n in ns:
+                if _has_grad(n):
                     out_grad_names.append(grad_var_name(n))
 
-        # in-grad outputs: fresh contribution names per target var.
+        _write_barrier(op)
+
+        if not grad_targets:
+            continue
+
+        # in-grad outputs: contribution names within the target's epoch.
         out_names, touched = [], []
         for n in grad_targets:
-            k = len(contribs.setdefault(n, []))
-            cname = grad_var_name(n) if k == 0 else _grad_contrib_name(n, k)
-            contribs[n].append(cname)
+            epochs = contribs.setdefault(n, [[]])
+            epoch = epochs[-1]
+            if not epoch:
+                cname = grad_var_name(n)
+            else:
+                k = rename_counter.get(n, 0) + 1
+                rename_counter[n] = k
+                cname = _grad_contrib_name(n, k)
             out_names.append(cname)
             touched.append(n)
             _ensure_grad_var(block, block.var(n), cname)
@@ -102,12 +142,13 @@ def append_backward(loss: ir.Variable,
         )
         block.ops.append(grad_op)
         program._bump()
-        grad_ops_meta.append((grad_op, touched))
+        for n, cname in zip(touched, out_names):
+            contribs[n][-1].append((cname, grad_op))
 
-    # 3. Fan-in accumulation: for vars with >1 contributions, rename the first
-    # contribution and insert a `sum` op after the last contribution
-    # (reference `_addup_repetitive_outputs_`).
-    _insert_sum_ops(block, contribs, loss.name)
+    # 3. Fan-in accumulation per epoch: rename the epoch's first contribution
+    # (which took the canonical name) and insert a `sum` op right after the
+    # epoch's last contribution (reference `_addup_repetitive_outputs_`).
+    _insert_sum_ops(block, contribs, loss.name, rename_counter)
 
     # 4. Collect (param, grad) pairs.
     params = block.all_parameters()
@@ -124,28 +165,35 @@ def append_backward(loss: ir.Variable,
     return pairs
 
 
-def _insert_sum_ops(block: ir.Block, contribs: Dict[str, List[str]], loss_name: str):
-    multi = {n: cs for n, cs in contribs.items() if len(cs) > 1 and n != loss_name}
-    if not multi:
+def _insert_sum_ops(block: ir.Block, contribs, loss_name: str,
+                    rename_counter: Dict[str, int]):
+    # Collect (var, epoch) groups needing a sum, with their op references.
+    pending = []  # (n, [(cname, op), ...])
+    for n, epochs in contribs.items():
+        if n == loss_name:
+            continue
+        for epoch in epochs:
+            if len(epoch) > 1:
+                pending.append((n, epoch))
+    if not pending:
         return
-    # Rename the k=0 contribution (which took the canonical name) in its
-    # producing op, then sum all contributions into the canonical name.
-    for n, cs in multi.items():
+    for n, epoch in pending:
         canonical = grad_var_name(n)
-        renamed0 = _grad_contrib_name(n, 0)
-        last_idx = -1
-        first = True
-        for i, op in enumerate(block.ops):
-            for slot, names in op.outputs.items():
-                for j, out in enumerate(names):
-                    if out == canonical and op.type.endswith(GRAD_OP_SUFFIX) and first:
-                        names[j] = renamed0
-                        first = False
-                        last_idx = max(last_idx, i)
-                    elif out in cs:
-                        last_idx = max(last_idx, i)
-        srcs = [renamed0] + cs[1:]
+        # rename the epoch's first contribution (it took the canonical name)
+        first_name, first_op = epoch[0]
+        k = rename_counter.get(n, 0) + 1
+        rename_counter[n] = k
+        renamed0 = _grad_contrib_name(n, k)
+        for slot, names in first_op.outputs.items():
+            for j, out in enumerate(names):
+                if out == first_name:
+                    names[j] = renamed0
         _ensure_grad_var(block, block.var(n), renamed0)
+        srcs = [renamed0] + [c for c, _ in epoch[1:]]
+        # insert the sum right after the epoch's last contributing op
+        ops_in_epoch = {id(op) for _, op in epoch}
+        last_idx = max(i for i, op in enumerate(block.ops)
+                       if id(op) in ops_in_epoch)
         block.insert_op(last_idx + 1, "sum",
                         inputs={"X": srcs}, outputs={"Out": [canonical]})
 

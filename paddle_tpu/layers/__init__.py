@@ -9,8 +9,12 @@ from .ops import *  # noqa: F401,F403
 from .metric_op import accuracy, auc  # noqa: F401
 from .loss_layers import (nce, hsigmoid, linear_chain_crf,  # noqa: F401
                           crf_decoding, warpctc, edit_distance)
-from .control_flow import (While, StaticRNN, Switch, increment,  # noqa: F401
-                           less_than, equal, array_write, array_read)
+from .control_flow import (While, StaticRNN, Switch, DynamicRNN,  # noqa: F401
+                           IfElse, increment, less_than, equal,
+                           create_array, array_write, array_read,
+                           array_length, lod_rank_table, max_sequence_len,
+                           lod_tensor_to_array, array_to_lod_tensor,
+                           shrink_memory, reorder_lod_tensor_by_rank)
 from . import learning_rate_scheduler  # noqa: F401
 from .math_op_patch import monkey_patch_variable
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import contextlib
 
+from .. import unique_name
 from ..core import ir
 from ..layer_helper import LayerHelper
 from . import tensor as lt
@@ -54,9 +55,14 @@ class While:
     loop-carried state.
     """
 
-    def __init__(self, cond, is_test=False, name=None):
+    def __init__(self, cond, is_test=False, name=None, max_iters=None):
+        """`max_iters` bounds the loop with a fixed-length masked scan so
+        gradients flow through it (op `bounded_while`); without it the loop
+        is a lax.while_loop, which is forward-only (reference while_grad,
+        while_op.cc:96, is the analogous backward machinery)."""
         self.helper = LayerHelper("while", name=name)
         self.cond_var = cond
+        self.max_iters = max_iters
 
     @contextlib.contextmanager
     def block(self):
@@ -81,14 +87,41 @@ class While:
         x_inputs = sorted(set(ir.external_reads(program, sub_block.idx))
                           | set(carry))
 
+        # SSA snapshot of the loop-carried state: the while op mutates its
+        # carries in place, so a grad op re-tracing the loop later would read
+        # POST-loop values (e.g. cond already false -> identity loop, wrong
+        # grads). Copy each carry to a fresh `@PRE` var the op reads instead;
+        # `assign`'s grad then routes carry grads back to the real producers
+        # through the normal fan-in machinery.
+        pre_map = {}
+        for n in carry:
+            pre = parent_block.create_var(
+                name=unique_name.generate(f"{n}@PRE"),
+                shape=parent_block._find_var_recursive(n).shape
+                if parent_block._find_var_recursive(n) is not None else (),
+                dtype=parent_block._find_var_recursive(n).dtype
+                if parent_block._find_var_recursive(n) is not None
+                else "float32")
+            parent_block.append_op("assign", inputs={"X": [n]},
+                                   outputs={"Out": [pre.name]})
+            pre_map[n] = pre.name
+
+        attrs = {"sub_block": sub_block.idx, "carry_vars": list(carry),
+                 "cond_var": self.cond_var.name,
+                 "carry_pre": {n: pre_map[n] for n in carry}}
+        op_type = "while"
+        if self.max_iters is not None:
+            op_type = "bounded_while"
+            attrs["max_iters"] = int(self.max_iters)
+        x_ext = [n for n in x_inputs
+                 if parent_block._find_var_recursive(n) is not None
+                 and n not in pre_map]
         parent_block.append_op(
-            "while",
-            inputs={"X": [n for n in x_inputs
-                          if parent_block._find_var_recursive(n) is not None],
-                    "Condition": [self.cond_var.name]},
+            op_type,
+            inputs={"X": x_ext + [pre_map[n] for n in carry],
+                    "Condition": [pre_map[self.cond_var.name]]},
             outputs={"Out": list(carry)},
-            attrs={"sub_block": sub_block.idx, "carry_vars": list(carry),
-                   "cond_var": self.cond_var.name})
+            attrs=attrs)
 
 
 class StaticRNN:
@@ -276,12 +309,396 @@ def _always_true(block):
     return v
 
 
-def array_write(x, i, array=None):
-    raise NotImplementedError(
-        "tensor_array ops land with the DynamicRNN milestone; use StaticRNN "
-        "or the scan-based dynamic_lstm/dynamic_gru layers")
+# ---------------------------------------------------------------------------
+# Tensor arrays (reference: layers/control_flow.py array_write :1030,
+# array_read :1120, array_length :1190, tensor_array_read_write_op.cc).
+# A tensor array is a pre-allocated [capacity, ...] device buffer plus an
+# `@ALEN` int32 length companion — see ops/tensor_array.py for the redesign
+# rationale (XLA static shapes forbid the reference's growing host vector).
+# ---------------------------------------------------------------------------
+
+ALEN_SUFFIX = "@ALEN"
+
+
+def _alen_var(block, array):
+    name = array.name + ALEN_SUFFIX
+    if name in block.vars:
+        return block.vars[name]
+    return block.create_var(name=name, shape=(), dtype="int32",
+                            stop_gradient=True)
+
+
+def create_array(dtype="float32", capacity=None):
+    """Declare a tensor-array variable (reference create_array). `capacity`
+    bounds the number of entries (static buffer size); defaults to
+    ops.tensor_array.DEFAULT_ARRAY_CAPACITY at first write."""
+    helper = LayerHelper("array")
+    arr = helper.block.create_var(
+        name=unique_name.generate("array"), shape=(), dtype=dtype)
+    arr.is_tensor_array = True
+    arr.array_capacity = capacity
+    arr.array_written = False
+    return arr
+
+
+def array_write(x, i, array=None, capacity=None):
+    """Write x into array[i]; returns the array (reference :1030)."""
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(dtype=x.dtype, capacity=capacity)
+    block = helper.block
+    alen = _alen_var(block, array)
+    inputs = {"X": [x.name], "I": [i.name]}
+    written = getattr(array, "array_written", True)
+    if written:
+        inputs["Array"] = [array.name]
+        inputs["ALen"] = [alen.name]
+    cap = capacity or getattr(array, "array_capacity", None)
+    attrs = {"capacity": int(cap)} if cap else {}
+    helper.append_op("array_write", inputs=inputs,
+                     outputs={"Out": [array.name], "OutLen": [alen.name]},
+                     attrs=attrs)
+    array.array_written = True
+    return array
 
 
 def array_read(array, i):
-    raise NotImplementedError(
-        "tensor_array ops land with the DynamicRNN milestone")
+    """Read array[i] (reference :1120)."""
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(dtype=array.dtype)
+    helper.append_op("array_read",
+                     inputs={"Array": [array.name], "I": [i.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def array_length(array):
+    """Logical length of the array (reference :1190)."""
+    helper = LayerHelper("array_length")
+    alen = _alen_var(helper.block, array)
+    out = helper.create_variable_for_type_inference(dtype="int32")
+    out.stop_gradient = True
+    helper.append_op("array_length", inputs={"ALen": [alen.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def lod_rank_table(x, level=0):
+    """Sequence rank table (reference lod_rank_table :828). On the padded
+    representation this is the row-lengths vector (ops/tensor_array.py)."""
+    helper = LayerHelper("lod_rank_table")
+    inputs = {"X": [x.name]}
+    seq = helper.ensure_seqlen_var(x)
+    if seq is not None:
+        inputs["SeqLen"] = [seq.name]
+    out = helper.create_variable_for_type_inference(dtype="int32")
+    out.stop_gradient = True
+    helper.append_op("lod_rank_table", inputs=inputs,
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def max_sequence_len(rank_table):
+    """Max length in a rank table (reference max_sequence_len :895)."""
+    helper = LayerHelper("max_seqence_len")
+    out = helper.create_variable_for_type_inference(dtype="int32")
+    out.stop_gradient = True
+    helper.append_op("max_sequence_len", inputs={"RankTable": [rank_table.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def lod_tensor_to_array(x, table):
+    """[B,T,...] LoD tensor -> time-major tensor array (reference :925)."""
+    helper = LayerHelper("lod_tensor_to_array")
+    array = create_array(dtype=x.dtype)
+    alen = _alen_var(helper.block, array)
+    helper.append_op("lod_tensor_to_array",
+                     inputs={"X": [x.name], "RankTable": [table.name]},
+                     outputs={"Out": [array.name], "OutLen": [alen.name]})
+    array.array_written = True
+    return array
+
+
+def array_to_lod_tensor(x, table):
+    """Tensor array -> [B,T,...] LoD tensor with lengths restored (:975)."""
+    helper = LayerHelper("array_to_lod_tensor")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    out.lod_level = 1
+    helper.append_op("array_to_lod_tensor",
+                     inputs={"X": [x.name], "RankTable": [table.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def shrink_memory(x, i, table):
+    """Freeze finished rows at step i (reference shrink_rnn_memory_op.cc);
+    masked-select analog — see ops/tensor_array.py."""
+    helper = LayerHelper("shrink_memory")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("shrink_memory",
+                     inputs={"X": [x.name], "I": [i.name],
+                             "RankTable": [table.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    helper = LayerHelper("reorder_lod_tensor_by_rank")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    idx = helper.create_variable_for_type_inference(dtype="int32")
+    idx.stop_gradient = True
+    helper.append_op("reorder_lod_tensor_by_rank",
+                     inputs={"X": [x.name], "RankTable": [rank_table.name]},
+                     outputs={"Out": [out.name], "OutIndex": [idx.name]})
+    return out
+
+
+class DynamicRNN:
+    """Variable-length RNN builder (reference control_flow.py:1538).
+
+    with rnn.block():
+        x_t = rnn.step_input(seq)          # [B,T,D] lod var -> [B,D]
+        h = rnn.memory(shape=[H], value=0) # carried, frozen past row length
+        nh = some_layers(x_t, h)
+        rnn.update_memory(h, nh)
+        rnn.output(nh)
+    out = rnn()                            # [B,T,H] lod var
+
+    Lowered to ONE masked lax.scan (op `dynamic_rnn`, ops/control.py) instead
+    of the reference's lod_rank_table/while/shrink_rnn_memory pipeline —
+    identical numerics on the padded representation.
+    """
+
+    BEFORE_RNN = 0
+    IN_RNN = 1
+    AFTER_RNN = 2
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("dynamic_rnn", name=name)
+        self.status = DynamicRNN.BEFORE_RNN
+        self._step_inputs = []   # (outer_name, inner_name)
+        self._static_inputs = []
+        self._memories = []      # [pre_name, mem_name or None, init_name]
+        self._step_outputs = []
+        self._outputs = []
+        self._sub_block = None
+        self._parent_block = None
+        self._seq_var = None     # first step_input's outer var (for lengths)
+
+    @contextlib.contextmanager
+    def block(self):
+        if self.status != DynamicRNN.BEFORE_RNN:
+            raise ValueError("rnn.block() can only be entered once")
+        program = self.helper.main_program
+        self._parent_block = program.current_block()
+        self._sub_block = program._create_block()
+        self.status = DynamicRNN.IN_RNN
+        yield
+        program._rollback()
+        self.status = DynamicRNN.AFTER_RNN
+        self._finalize()
+
+    def step_input(self, x, level=0):
+        self._assert_in_rnn("step_input")
+        if self._seq_var is None:
+            self._seq_var = x
+        inner = self._sub_block.create_var(
+            name=f"{self.helper.name}.in_{len(self._step_inputs)}",
+            shape=(x.shape[0],) + tuple(x.shape[2:]), dtype=x.dtype)
+        self._step_inputs.append((x.name, inner.name))
+        return inner
+
+    def static_input(self, x):
+        """A var visible unchanged at every step (reference :1636) — with
+        whole-batch masking no reorder is needed; the var is simply read."""
+        self._assert_in_rnn("static_input")
+        self._static_inputs.append(x.name)
+        return x
+
+    def memory(self, init=None, shape=None, value=0.0, need_reorder=False,
+               dtype="float32"):
+        self._assert_in_rnn("memory")
+        if init is None:
+            if shape is None:
+                raise ValueError("memory() needs `init` or `shape`")
+            if self._seq_var is None:
+                raise ValueError("call step_input() before shape-based memory()")
+            program = self.helper.main_program
+            cur = program._current_block_idx
+            program._current_block_idx = self._parent_block.idx
+            try:
+                init = lt.fill_constant_batch_size_like(
+                    self._seq_var, [-1] + list(shape), dtype, value,
+                    input_dim_idx=0, output_dim_idx=0)
+            finally:
+                program._current_block_idx = cur
+        pre = self._sub_block.create_var(
+            name=f"{self.helper.name}.mem_{len(self._memories)}",
+            shape=init.shape, dtype=init.dtype)
+        self._memories.append([pre.name, None, init.name])
+        return pre
+
+    def update_memory(self, ex_mem, new_mem):
+        self._assert_in_rnn("update_memory")
+        for m in self._memories:
+            if m[0] == ex_mem.name:
+                m[1] = new_mem.name
+                return
+        raise ValueError(f"{ex_mem.name} is not a memory of this DynamicRNN")
+
+    def output(self, *outputs):
+        self._assert_in_rnn("output")
+        for o in outputs:
+            self._step_outputs.append(o.name)
+
+    def _assert_in_rnn(self, method):
+        if self.status != DynamicRNN.IN_RNN:
+            raise ValueError(f"{method}() must be called inside rnn.block()")
+
+    def _finalize(self):
+        if not self._step_inputs:
+            raise ValueError("DynamicRNN needs at least one step_input")
+        for m in self._memories:
+            if m[1] is None:
+                raise ValueError(f"memory {m[0]} was never update_memory()-ed")
+        if not self._step_outputs:
+            raise ValueError("DynamicRNN needs at least one output")
+        program = self.helper.main_program
+        outs = []
+        for inner_name in self._step_outputs:
+            inner = self._sub_block.vars.get(inner_name)
+            shape = ((inner.shape[0], -1) + tuple(inner.shape[1:])
+                     if inner is not None and inner.shape else ())
+            out = self._parent_block.create_var(
+                name=f"{self.helper.name}.out_{len(outs)}",
+                shape=shape, dtype=inner.dtype if inner else "float32")
+            out.lod_level = 1
+            outs.append(out)
+        self._outputs = outs
+        externals = [n for n in ir.external_reads(program, self._sub_block.idx)
+                     if self._parent_block._find_var_recursive(n) is not None]
+        init_names = [m[2] for m in self._memories]
+        x_names = [outer for outer, _ in self._step_inputs]
+        all_ins = list(dict.fromkeys(x_names + init_names
+                                     + self._static_inputs + externals))
+        inputs = {"X": all_ins}
+        from ..core.ir import seqlen_var_name
+        seq_name = seqlen_var_name(self._seq_var.name)
+        if self._seq_var.lod_level > 0:
+            blk = self._seq_var.block
+            if seq_name not in blk.vars:
+                blk.create_var(name=seq_name, shape=(-1,), dtype="int32",
+                               stop_gradient=True)
+            inputs["SeqLen"] = [seq_name]
+        self._parent_block.append_op(
+            "dynamic_rnn",
+            inputs=inputs,
+            outputs={"Out": [o.name for o in outs],
+                     "OutLen": [seqlen_var_name(o.name) for o in outs]},
+            attrs={"sub_block": self._sub_block.idx,
+                   "step_inputs": [list(p) for p in self._step_inputs],
+                   "memories": [list(m) for m in self._memories],
+                   "step_outputs": list(self._step_outputs)})
+        for o in outs:
+            if seqlen_var_name(o.name) not in self._parent_block.vars:
+                self._parent_block.create_var(
+                    name=seqlen_var_name(o.name), shape=(-1,), dtype="int32",
+                    stop_gradient=True)
+
+    def __call__(self):
+        if self.status != DynamicRNN.AFTER_RNN:
+            raise ValueError("DynamicRNN outputs are available after block()")
+        if len(self._outputs) == 1:
+            return self._outputs[0]
+        return self._outputs
+
+
+class IfElse:
+    """Per-row two-way branch (reference control_flow.py:1408).
+
+    ie = IfElse(cond)           # cond: [B,1] bool
+    with ie.true_block():
+        x_t = ie.input(x)
+        ie.output(f(x_t))
+    with ie.false_block():
+        ie.output(g(ie.input(x)))
+    out, = ie()
+
+    Reference splits the batch by mask, runs each branch on its slice, and
+    merges; here both branches run on the full batch and rows are selected
+    with `where` (op `if_else`, ops/control.py) — SPMD-friendly, no dynamic
+    shapes, same results for the row-local compute the API supports.
+    """
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper("ifelse", name=name)
+        self.cond = cond
+        self._blocks = {}        # "true"/"false" -> sub_block
+        self._outs = {"true": [], "false": []}
+        self._inputs = []
+        self._current = None
+
+    @contextlib.contextmanager
+    def true_block(self):
+        yield from self._branch("true")
+
+    @contextlib.contextmanager
+    def false_block(self):
+        yield from self._branch("false")
+
+    def _branch(self, which):
+        program = self.helper.main_program
+        self._parent_block = program.current_block()
+        sub = program._create_block()
+        self._blocks[which] = sub
+        self._current = which
+        yield
+        program._rollback()
+        self._current = None
+
+    def input(self, x):
+        if self._current is None:
+            raise ValueError("input() must be called inside a branch block")
+        if x.name not in self._inputs:
+            self._inputs.append(x.name)
+        return x
+
+    def output(self, *outs):
+        if self._current is None:
+            raise ValueError("output() must be called inside a branch block")
+        self._outs[self._current].extend(o.name for o in outs)
+
+    def __call__(self):
+        if "true" not in self._blocks or "false" not in self._blocks:
+            raise ValueError("IfElse needs both true_block and false_block")
+        nt, nf = len(self._outs["true"]), len(self._outs["false"])
+        if nt != nf:
+            raise ValueError(
+                f"true_block produced {nt} outputs, false_block {nf}; they "
+                f"must match")
+        program = self.helper.main_program
+        parent = program.current_block()
+        externals = []
+        for which in ("true", "false"):
+            for n in ir.external_reads(program, self._blocks[which].idx):
+                if parent._find_var_recursive(n) is not None \
+                        and n not in externals:
+                    externals.append(n)
+        outs = []
+        for tn in self._outs["true"]:
+            inner = self._blocks["true"].vars.get(tn)
+            out = parent.create_var(
+                name=f"{self.helper.name}.out_{len(outs)}",
+                shape=tuple(inner.shape) if inner is not None else (),
+                dtype=inner.dtype if inner is not None else "float32")
+            outs.append(out)
+        parent.append_op(
+            "if_else",
+            inputs={"Cond": [self.cond.name], "X": externals},
+            outputs={"Out": [o.name for o in outs]},
+            attrs={"true_block": self._blocks["true"].idx,
+                   "false_block": self._blocks["false"].idx,
+                   "true_outs": list(self._outs["true"]),
+                   "false_outs": list(self._outs["false"])})
+        return outs

@@ -8,6 +8,7 @@ from . import rnn  # noqa: F401
 from . import sequence  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import control  # noqa: F401
+from . import tensor_array  # noqa: F401
 from . import beam  # noqa: F401
 from . import loss_extra  # noqa: F401
 from . import pallas_attention  # noqa: F401

@@ -37,9 +37,10 @@ def _while(ctx, X=None, Condition=None):
     sub_idx = ctx.attr("sub_block")
     carry_names = list(ctx.attr("carry_vars"))
     cond_name = ctx.attr("cond_var")
+    pre_map = ctx.attr("carry_pre", {}) or {}
     key = ctx.key if ctx.key is not None else jax.random.key(0)
 
-    init_carry = {n: env[n] for n in carry_names}
+    init_carry = {n: env[pre_map.get(n, n)] for n in carry_names}
     init_carry["__loop_t__"] = jnp.int32(0)
 
     def cond_fn(carry):
@@ -139,6 +140,130 @@ def _conditional_block(ctx, Cond, X=None):
 
     outs = lax.cond(pred, true_fn, false_fn, None)
     return {"Out": list(outs)}
+
+
+@register_op("bounded_while", propagate_seqlen=False, needs_rng=True)
+def _bounded_while(ctx, X=None, Condition=None):
+    """Differentiable while: a `While(cond, max_iters=N)` loop lowered to a
+    fixed-length lax.scan with a per-iteration done-mask, because
+    lax.while_loop has no reverse-mode derivative. Iterations after the
+    condition turns false keep the carry unchanged, so the numerics match the
+    dynamic loop exactly while staying reverse-differentiable (the reference's
+    while_grad runs the sub-block backward with step scopes,
+    while_op.cc:96 — here jax.vjp through the scan delivers the same grads).
+    """
+    lowerer = ctx.lowerer
+    env = ctx.env
+    sub_idx = ctx.attr("sub_block")
+    carry_names = list(ctx.attr("carry_vars"))
+    cond_name = ctx.attr("cond_var")
+    pre_map = ctx.attr("carry_pre", {}) or {}
+    n_iters = int(ctx.attr("max_iters"))
+    key = ctx.key if ctx.key is not None else jax.random.key(0)
+
+    init_carry = {n: env[pre_map.get(n, n)] for n in carry_names}
+
+    def body(carry, t):
+        live = carry[cond_name].reshape(())
+        step_key = jax.random.fold_in(key, t)
+        env2 = _run_sub(lowerer, sub_idx, env, dict(carry), step_key)
+        out = {n: jnp.where(live, env2[n], carry[n]) for n in carry_names}
+        return out, None
+
+    final, _ = lax.scan(body, init_carry, jnp.arange(n_iters))
+    return {"Out": [final[n] for n in carry_names]}
+
+
+@register_op("dynamic_rnn", propagate_seqlen=False, needs_rng=True)
+def _dynamic_rnn(ctx, X=None, SeqLen=None):
+    """Variable-length RNN over padded batches (reference DynamicRNN,
+    python/paddle/fluid/layers/control_flow.py:1538, lowered there to
+    lod_rank_table + lod_tensor_to_array + while + shrink_rnn_memory).
+
+    TPU-native redesign: one lax.scan over the time axis with per-row
+    masking — a row's memory freezes once t >= its length (the masked-update
+    equivalent of shrink_rnn_memory's physical batch shrink), and step
+    outputs are zeroed past the row's length, so the stacked output matches
+    the reference's LoD output and `sequence_pool('last')` recovers each
+    row's final state. attrs mirror static_rnn plus the lengths input.
+    """
+    lowerer = ctx.lowerer
+    env = ctx.env
+    sub_idx = ctx.attr("sub_block")
+    step_inputs = [tuple(p) for p in ctx.attr("step_inputs")]
+    memories = [tuple(m) for m in ctx.attr("memories")]
+    step_outputs = list(ctx.attr("step_outputs"))
+    key = ctx.key if ctx.key is not None else jax.random.key(0)
+
+    first_outer = step_inputs[0][0]
+    x0 = env[first_outer]
+    B, T = x0.shape[0], x0.shape[1]
+    lengths = (SeqLen.reshape(-1) if SeqLen is not None
+               else jnp.full((B,), T, jnp.int32))
+
+    xs = {inner: jnp.swapaxes(env[outer], 0, 1)  # [T, B, ...]
+          for outer, inner in step_inputs}
+    init_mems = {pre: env[init] for pre, mem, init in memories}
+    init_mems["__loop_t__"] = jnp.int32(0)
+
+    def _row_mask(active, v):
+        # boolean select (NOT arithmetic x*m): padded timesteps may compute
+        # NaN/Inf (div/log over garbage), and 0*NaN would poison the output
+        m = active
+        while m.ndim < v.ndim:
+            m = m[..., None]
+        return m
+
+    def body(carry, xt):
+        t = carry.pop("__loop_t__")
+        active = lengths > t                       # [B]
+        carry_in = dict(carry)
+        carry_in.update(xt)
+        step_key = jax.random.fold_in(key, t)
+        env2 = _run_sub(lowerer, sub_idx, env, carry_in, step_key)
+        new_carry = {}
+        for pre, mem, init in memories:
+            old, new = carry[pre], env2[mem]
+            new_carry[pre] = jnp.where(_row_mask(active, new), new, old)
+        new_carry["__loop_t__"] = t + 1
+        outs = tuple(jnp.where(_row_mask(active, env2[n]), env2[n],
+                               jnp.zeros((), env2[n].dtype))
+                     for n in step_outputs)
+        return new_carry, outs
+
+    _, stacked = lax.scan(body, init_mems, xs)
+    return {"Out": [jnp.swapaxes(s, 0, 1) for s in stacked],
+            "OutLen": [lengths.astype(jnp.int32)] * len(step_outputs)}
+
+
+@register_op("if_else", propagate_seqlen=False, needs_rng=True)
+def _if_else(ctx, Cond, X=None):
+    """Per-row conditional (reference IfElse, control_flow.py:1408): the
+    reference physically splits the batch by the [B,1] bool mask, runs each
+    sub-block on its rows, and merges. TPU-native redesign: both branches run
+    on the FULL batch (SPMD-friendly, no dynamic shapes) and outputs are
+    merged row-wise with `where` — identical results for row-local compute,
+    which is what the reference API supports.
+    attrs: true_block, false_block, true_outs, false_outs (inner names)."""
+    lowerer = ctx.lowerer
+    env = ctx.env
+    true_idx = ctx.attr("true_block")
+    false_idx = ctx.attr("false_block")
+    true_outs = list(ctx.attr("true_outs"))
+    false_outs = list(ctx.attr("false_outs"))
+    key = ctx.key if ctx.key is not None else jax.random.key(0)
+
+    env_t = _run_sub(lowerer, true_idx, env, {}, key)
+    env_f = _run_sub(lowerer, false_idx, env, {}, key)
+    cond = Cond.reshape(Cond.shape[0])            # [B]
+    merged = []
+    for tn, fn in zip(true_outs, false_outs):
+        tv, fv = env_t[tn], env_f[fn]
+        c = cond
+        while c.ndim < tv.ndim:
+            c = c[..., None]
+        merged.append(jnp.where(c, tv, fv.astype(tv.dtype)))
+    return {"Out": merged}
 
 
 @register_op("select_input", propagate_seqlen=False)

@@ -245,7 +245,8 @@ def _range(ctx):
 
 @register_op("increment")
 def _increment(ctx, X):
-    return {"Out": X + ctx.attr("step", 1.0)}
+    # keep X's dtype (int counters must stay int inside loop carries)
+    return {"Out": X + jnp.asarray(ctx.attr("step", 1.0)).astype(X.dtype)}
 
 
 @register_op("reverse")

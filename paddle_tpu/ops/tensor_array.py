@@ -1,0 +1,138 @@
+"""Tensor-array + LoD-rank-table op lowerings (dynamic-RNN plumbing).
+
+Capability parity with the reference's LoDTensorArray machinery (reference:
+paddle/fluid/operators/tensor_array_read_write_op.cc,
+lod_rank_table_op.cc, lod_tensor_to_array_op.cc, array_to_lod_tensor_op.cc,
+shrink_rnn_memory_op.cc, max_sequence_len_op.cc,
+framework/lod_tensor_array.h, framework/lod_rank_table.h).
+
+TPU-native redesign: the reference's LoDTensorArray is a host-side
+vector<LoDTensor> that grows per `while` iteration — impossible under XLA's
+static shapes. Here a tensor array is a pre-allocated dense buffer
+`[capacity, ...]` living in the traced program, written/read with
+`lax.dynamic_update_index_in_dim` / `dynamic_index_in_dim`, so the whole
+while/scan loop stays on-device. The companion scalar `name@ALEN` (int32)
+tracks the logical length, mirroring `@SEQLEN` for sequences.
+
+The LoD rank table (sort-sequences-by-length so the batch can shrink as
+short rows finish — shrink_rnn_memory) is replaced by masking on the padded
+representation: the "rank table" value is simply the row-lengths vector, and
+`shrink_memory` becomes a per-row `where(t < len, new, old)` select. Same
+numerics, no data-dependent shapes, and XLA fuses the masks for free.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+# Default buffer capacity for arrays written before their extent is known
+# (e.g. decode loops). lod_tensor_to_array sizes buffers exactly from T.
+DEFAULT_ARRAY_CAPACITY = 128
+
+
+def _as_index(i):
+    return jnp.asarray(i).reshape(()).astype(jnp.int32)
+
+
+@register_op("array_write", propagate_seqlen=False)
+def _array_write(ctx, X, I, Array=None, ALen=None):
+    """Write X at index I. Array is the pre-allocated [cap, ...] buffer; when
+    absent (first write) a zero buffer of `capacity` entries is allocated at
+    trace time (reference tensor_array_read_write_op.cc grows a vector).
+
+    Overflow contract: lax.dynamic_update clamps out-of-range indices, which
+    would silently corrupt slot cap-1; instead a write at I >= capacity is a
+    NO-OP on the buffer while OutLen still records max(len, I+1) — so
+    `array_length(arr) > capacity` is the runtime-checkable overflow signal
+    (XLA programs cannot raise; reference host vectors grew unboundedly)."""
+    i = _as_index(I)
+    if Array is None:
+        cap = int(ctx.attr("capacity", DEFAULT_ARRAY_CAPACITY))
+        Array = jnp.zeros((cap,) + tuple(X.shape), X.dtype)
+    if ALen is None:
+        ALen = jnp.int32(0)
+    in_range = i < Array.shape[0]
+    buf = lax.dynamic_update_index_in_dim(Array, X.astype(Array.dtype),
+                                          jnp.minimum(i, Array.shape[0] - 1), 0)
+    buf = jnp.where(in_range, buf, Array)
+    return {"Out": buf, "OutLen": jnp.maximum(ALen, i + 1)}
+
+
+@register_op("array_read", propagate_seqlen=False)
+def _array_read(ctx, Array, I):
+    return {"Out": lax.dynamic_index_in_dim(Array, _as_index(I), 0,
+                                            keepdims=False)}
+
+
+@register_op("array_length", propagate_seqlen=False)
+def _array_length(ctx, ALen):
+    return {"Out": ALen.reshape(())}
+
+
+@register_op("lod_rank_table", propagate_seqlen=False)
+def _lod_rank_table(ctx, X, SeqLen=None):
+    """The rank table degenerates to the lengths vector [B] (see module doc).
+    With no @SEQLEN companion every row has the full time extent."""
+    if SeqLen is not None:
+        return {"Out": SeqLen.astype(jnp.int32)}
+    B = X.shape[0]
+    T = X.shape[1] if X.ndim > 1 else 1
+    return {"Out": jnp.full((B,), T, jnp.int32)}
+
+
+@register_op("max_sequence_len", propagate_seqlen=False)
+def _max_sequence_len(ctx, RankTable):
+    return {"Out": jnp.max(RankTable)}
+
+
+@register_op("lod_tensor_to_array", propagate_seqlen=False)
+def _lod_tensor_to_array(ctx, X, RankTable=None):
+    """[B, T, ...] -> time-major buffer [T, B, ...] (the array has exactly T
+    entries; entry t is the batch slice at step t). Reference
+    lod_tensor_to_array_op.cc buckets rows by length; masking makes that
+    unnecessary here."""
+    buf = jnp.swapaxes(X, 0, 1)
+    T = X.shape[1]
+    return {"Out": buf, "OutLen": jnp.int32(T)}
+
+
+@register_op("array_to_lod_tensor", propagate_seqlen=False)
+def _array_to_lod_tensor(ctx, X, RankTable=None):
+    """Inverse of lod_tensor_to_array: [T, B, ...] buffer -> [B, T, ...],
+    re-attaching lengths (@SEQLEN) from the rank table."""
+    out = jnp.swapaxes(X, 0, 1)
+    outs = {"Out": out}
+    if RankTable is not None:
+        T = out.shape[1]
+        mask = (jnp.arange(T)[None, :] < RankTable.reshape(-1, 1))
+        m = mask.astype(out.dtype)
+        while m.ndim < out.ndim:
+            m = m[..., None]
+        outs["Out"] = out * m
+    return outs
+
+
+@register_op("shrink_memory", propagate_seqlen=False)
+def _shrink_memory(ctx, X, I, RankTable):
+    """Reference shrink_rnn_memory_op.cc drops the rows whose sequence has
+    ended at step I (batch physically shrinks). Padded analog: rows with
+    len <= I are frozen by the caller's masked update; this op returns X with
+    finished rows' contribution masked so downstream reductions ignore them."""
+    i = _as_index(I)
+    active = (RankTable.reshape(-1) > i)
+    m = active.astype(X.dtype)
+    while m.ndim < X.ndim:
+        m = m[..., None]
+    return {"Out": X * m}
+
+
+@register_op("reorder_lod_tensor_by_rank", propagate_seqlen=False)
+def _reorder_lod_tensor_by_rank(ctx, X, RankTable):
+    """Reference reorder_lod_tensor_by_rank_op.cc sorts rows to rank-table
+    order (longest first). Masking removes the need to sort, but the op is
+    provided for program parity: rows are permuted by descending length."""
+    order = jnp.argsort(-RankTable.reshape(-1), stable=True)
+    return {"Out": jnp.take(X, order, axis=0), "OutIndex": order.astype(jnp.int32)}

@@ -1,0 +1,305 @@
+"""DynamicRNN / IfElse / tensor-array / bounded-while tests
+(reference tests: test_dyn_rnn.py, test_ifelse*.py, test_lod_tensor_array*,
+test_while_op.py, test_shrink_rnn_memory.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _exe():
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe
+
+
+# ---------------------------------------------------------------------------
+# tensor arrays
+# ---------------------------------------------------------------------------
+
+def test_array_write_read_roundtrip():
+    x = layers.data(name="x", shape=[3], dtype="float32")
+    i0 = layers.fill_constant([1], "int32", 0)
+    i2 = layers.fill_constant([1], "int32", 2)
+    arr = layers.array_write(x, i0, capacity=4)
+    y = layers.scale(x, scale=2.0)
+    layers.array_write(y, i2, array=arr)
+    r0 = layers.array_read(arr, i0)
+    r2 = layers.array_read(arr, i2)
+    n = layers.array_length(arr)
+    exe = _exe()
+    xs = np.random.randn(2, 3).astype(np.float32)
+    a, b, ln = exe.run(feed={"x": xs}, fetch_list=[r0, r2, n])
+    np.testing.assert_allclose(a, xs, rtol=1e-6)
+    np.testing.assert_allclose(b, 2 * xs, rtol=1e-6)
+    assert int(np.asarray(ln)) == 3  # max written index + 1
+
+
+def test_array_write_in_while_loop():
+    """Write one entry per iteration, read them all back afterwards."""
+    x = layers.data(name="x", shape=[3], dtype="float32")
+    i = layers.fill_constant([1], "int32", 0)
+    limit = layers.fill_constant([1], "int32", 4)
+    arr = layers.array_write(x, i, capacity=8)
+    cond = layers.less_than(i, limit)
+    w = layers.While(cond)
+    with w.block():
+        cur = layers.array_read(arr, i)
+        layers.array_write(layers.scale(cur, scale=2.0),
+                           layers.increment(i, 1), array=arr)
+        layers.less_than(i, limit, cond=cond)
+    r3 = layers.array_read(arr, layers.fill_constant([1], "int32", 3))
+    exe = _exe()
+    xs = np.ones((2, 3), np.float32)
+    out, = exe.run(feed={"x": xs}, fetch_list=[r3])
+    np.testing.assert_allclose(out, 8 * xs, rtol=1e-6)  # 2^3
+
+
+def test_lod_tensor_to_array_roundtrip_masks_padding():
+    x = layers.data(name="x", shape=[4], dtype="float32", lod_level=1)
+    table = layers.lod_rank_table(x)
+    arr = layers.lod_tensor_to_array(x, table)
+    back = layers.array_to_lod_tensor(arr, table)
+    mx = layers.max_sequence_len(table)
+    exe = _exe()
+    xs = np.random.randn(3, 5, 4).astype(np.float32)
+    lens = np.array([5, 2, 3], np.int32)
+    out, m = exe.run(feed={"x": (xs, lens)}, fetch_list=[back, mx])
+    mask = (np.arange(5)[None, :] < lens[:, None]).astype(np.float32)
+    np.testing.assert_allclose(out, xs * mask[..., None], rtol=1e-6)
+    assert int(np.asarray(m)) == 5
+
+
+def test_shrink_memory_masks_finished_rows():
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    sl = layers.data(name="sl", shape=[], dtype="int32",
+                     append_batch_size=False)
+    i = layers.fill_constant([1], "int32", 2)
+    out = layers.shrink_memory(x, i, sl)
+    exe = _exe()
+    xs = np.ones((3, 4), np.float32)
+    lens = np.array([5, 2, 3], np.int32)
+    o, = exe.run(feed={"x": xs, "sl": lens}, fetch_list=[out])
+    # rows with len <= 2 are zeroed at step i=2
+    np.testing.assert_allclose(o[0], np.ones(4), rtol=1e-6)
+    np.testing.assert_allclose(o[1], np.zeros(4), rtol=1e-6)
+    np.testing.assert_allclose(o[2], np.ones(4), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# DynamicRNN
+# ---------------------------------------------------------------------------
+
+def _np_dynrnn_cumsum(xs, lens):
+    """Reference semantics: h_t = h_{t-1} + x_t while t < len; outputs zero
+    past a row's length; memory freezes at the row's last valid step."""
+    B, T, D = xs.shape
+    out = np.zeros_like(xs)
+    h = np.zeros((B, D), xs.dtype)
+    for t in range(T):
+        active = t < lens
+        nh = h + xs[:, t]
+        h = np.where(active[:, None], nh, h)
+        out[:, t] = np.where(active[:, None], nh, 0.0)
+    return out, h
+
+
+def test_dynamic_rnn_masked_cumsum():
+    x = layers.data(name="x", shape=[3], dtype="float32", lod_level=1)
+    rnn = layers.DynamicRNN()
+    with rnn.block():
+        xt = rnn.step_input(x)
+        h = rnn.memory(shape=[3], value=0.0)
+        nh = layers.elementwise_add(h, xt)
+        rnn.update_memory(h, nh)
+        rnn.output(nh)
+    out = rnn()
+    last = layers.sequence_pool(out, pool_type="last")
+    exe = _exe()
+    xs = np.random.randn(4, 6, 3).astype(np.float32)
+    lens = np.array([6, 3, 1, 4], np.int32)
+    o, lt = exe.run(feed={"x": (xs, lens)}, fetch_list=[out, last])
+    ref_out, ref_h = _np_dynrnn_cumsum(xs, lens)
+    np.testing.assert_allclose(o, ref_out, rtol=1e-5)
+    np.testing.assert_allclose(lt, ref_h, rtol=1e-5)
+
+
+def test_dynamic_rnn_trains_and_numeric_grad():
+    """An LM-shaped DynamicRNN: fc cell over variable-length rows. The
+    emitted grads are checked against central finite differences on the
+    cell weight (the reference's OpTest.check_grad methodology,
+    op_test.py:388)."""
+    np.random.seed(0)
+    B, T, D, H = 3, 5, 4, 4
+    x = layers.data(name="x", shape=[D], dtype="float32", lod_level=1)
+    rnn = layers.DynamicRNN()
+    with rnn.block():
+        xt = rnn.step_input(x)
+        h = rnn.memory(shape=[H], value=0.0)
+        nh = layers.fc(input=layers.concat([xt, h], axis=1), size=H,
+                       act="tanh", param_attr=fluid.ParamAttr(name="cell_w"),
+                       bias_attr=False)
+        rnn.update_memory(h, nh)
+        rnn.output(nh)
+    out = rnn()
+    pooled = layers.sequence_pool(out, pool_type="sum")
+    loss = layers.mean(pooled)
+    # forward-only clone BEFORE minimize: used for finite differences
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    opt = fluid.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(loss)
+
+    exe = _exe()
+    xs = np.random.randn(B, T, D).astype(np.float32)
+    lens = np.array([5, 2, 3], np.int32)
+    scope = fluid.global_scope()
+    w0 = np.array(scope.find_var("cell_w"))
+
+    def loss_at(w):
+        scope.set_var("cell_w", w.astype(np.float32))
+        l, = exe.run(test_prog, feed={"x": (xs, lens)}, fetch_list=[loss])
+        return float(np.asarray(l))
+
+    eps = 1e-3
+    num_grad = np.zeros_like(w0)
+    it = np.nditer(w0, flags=["multi_index"])
+    for _ in it:
+        idx = it.multi_index
+        wp, wm = w0.copy(), w0.copy()
+        wp[idx] += eps
+        wm[idx] -= eps
+        num_grad[idx] = (loss_at(wp) - loss_at(wm)) / (2 * eps)
+    scope.set_var("cell_w", w0.astype(np.float32))
+
+    # analytic grad recovered from one SGD step: grad = (w0 - w1) / lr
+    exe.run(feed={"x": (xs, lens)}, fetch_list=[loss])
+    w1 = np.array(scope.find_var("cell_w"))
+    ana_grad = (w0 - w1) / 0.1
+    np.testing.assert_allclose(ana_grad, num_grad, rtol=5e-2, atol=5e-3)
+
+
+def test_dynamic_rnn_length_invariance():
+    """Padding must not affect results: growing T with garbage padding
+    changes nothing (the reference's "no padding compute" claim)."""
+    def run(xs, lens):
+        x = layers.data(name="x", shape=[2], dtype="float32", lod_level=1)
+        rnn = layers.DynamicRNN()
+        with rnn.block():
+            xt = rnn.step_input(x)
+            h = rnn.memory(shape=[2], value=0.0)
+            nh = layers.elementwise_add(h, xt)
+            rnn.update_memory(h, nh)
+            rnn.output(nh)
+        last = layers.sequence_pool(rnn(), pool_type="last")
+        exe = _exe()
+        o, = exe.run(feed={"x": (xs, lens)}, fetch_list=[last])
+        return np.asarray(o)
+
+    xs = np.random.randn(2, 3, 2).astype(np.float32)
+    lens = np.array([3, 2], np.int32)
+    a = run(xs, lens)
+    padded = np.concatenate(
+        [xs, 99 * np.ones((2, 2, 2), np.float32)], axis=1)
+    import paddle_tpu.core.ir as ir
+    import paddle_tpu.core.executor as pexec
+    from paddle_tpu import unique_name
+    ir._main_program = ir.Program()
+    ir._startup_program = ir.Program()
+    pexec._global_scope = pexec.Scope()
+    unique_name._generator = unique_name.UniqueNameGenerator()
+    b = run(padded, lens)
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_overwrite_severs_gradients():
+    """A non-diff op overwriting a var must sever upstream grads (SSA write
+    barrier in append_backward): loss is constant wrt w here."""
+    x = layers.data(name="x", shape=[3], dtype="float32")
+    h = layers.fc(input=x, size=3, act=None, bias_attr=False,
+                  param_attr=fluid.ParamAttr(name="w_sever"))
+    layers.fill_constant([2, 3], "float32", 5.0, out=h)
+    loss = layers.mean(h)
+    fluid.optimizer.SGD(learning_rate=1.0).minimize(loss)
+    exe = _exe()
+    scope = fluid.global_scope()
+    w0 = np.array(scope.find_var("w_sever"))
+    exe.run(feed={"x": np.random.randn(2, 3).astype(np.float32)},
+            fetch_list=[loss])
+    w1 = np.array(scope.find_var("w_sever"))
+    np.testing.assert_allclose(w0, w1, rtol=0, atol=0)  # grad exactly zero
+
+
+# ---------------------------------------------------------------------------
+# IfElse
+# ---------------------------------------------------------------------------
+
+def test_ifelse_rowwise_select():
+    x = layers.data(name="x", shape=[3], dtype="float32")
+    zero = layers.fill_constant_batch_size_like(x, [-1, 1], "float32", 0.0)
+    row_sum = layers.reduce_sum(x, dim=[1], keep_dim=True)
+    cond = layers.less_than(zero, row_sum)   # row_sum > 0
+    ie = layers.IfElse(cond)
+    with ie.true_block():
+        xt = ie.input(x)
+        ie.output(layers.scale(xt, scale=2.0))
+    with ie.false_block():
+        xf = ie.input(x)
+        ie.output(layers.scale(xf, scale=-1.0))
+    out, = ie()
+    exe = _exe()
+    xs = np.array([[1, 1, 1], [-1, -1, -1], [2, -1, 0.5]], np.float32)
+    o, = exe.run(feed={"x": xs}, fetch_list=[out])
+    ref = np.where(xs.sum(1, keepdims=True) > 0, 2 * xs, -xs)
+    np.testing.assert_allclose(o, ref, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# bounded (differentiable) while
+# ---------------------------------------------------------------------------
+
+def test_bounded_while_matches_dynamic_while():
+    def build(max_iters):
+        i = layers.fill_constant([1], "float32", 0.0)
+        limit = layers.fill_constant([1], "float32", 7.0)
+        acc = layers.fill_constant([1], "float32", 0.0)
+        cond = layers.less_than(i, limit)
+        w = layers.While(cond, max_iters=max_iters)
+        with w.block():
+            layers.assign(acc + i, acc)
+            layers.increment(i, 1.0)
+            layers.less_than(i, limit, cond=cond)
+        return acc
+
+    acc = build(max_iters=10)   # loop runs 7 of the 10 budgeted iterations
+    exe = _exe()
+    out, = exe.run(fetch_list=[acc])
+    assert float(np.asarray(out)[0]) == 21.0  # 0+1+...+6
+
+
+def test_bounded_while_gradient():
+    """d/dw of (w applied max_iters times) — grads flow through the scan."""
+    x = layers.data(name="x", shape=[2], dtype="float32",
+                    stop_gradient=False)
+    i = layers.fill_constant([1], "float32", 0.0)
+    limit = layers.fill_constant([1], "float32", 3.0)
+    acc = layers.fc(input=x, size=2, act=None, bias_attr=False,
+                    param_attr=fluid.ParamAttr(name="w_loop"))
+    cond = layers.less_than(i, limit)
+    w = layers.While(cond, max_iters=5)
+    with w.block():
+        layers.assign(layers.scale(acc, scale=2.0), acc)
+        layers.increment(i, 1.0)
+        layers.less_than(i, limit, cond=cond)
+    loss = layers.mean(acc)
+    fluid.optimizer.SGD(learning_rate=1.0).minimize(loss)
+    exe = _exe()
+    scope = fluid.global_scope()
+    w0 = np.array(scope.find_var("w_loop"))
+    xs = np.ones((2, 2), np.float32)
+    exe.run(feed={"x": xs}, fetch_list=[loss])
+    w1 = np.array(scope.find_var("w_loop"))
+    grad = w0 - w1
+    # loss = mean(8 * x @ w) -> dloss/dw = 8 * x^T 1 / (B*2) = 8*2/(4) = 4
+    np.testing.assert_allclose(grad, np.full_like(w0, 4.0), rtol=1e-4)
