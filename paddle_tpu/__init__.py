@@ -39,6 +39,7 @@ from . import transpiler  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from .transpiler import memory_optimize, release_memory, InferenceTranspiler  # noqa: F401
 from . import distributed  # noqa: F401
+from . import pserver  # noqa: F401
 from .trainer import (Trainer, Inferencer, CheckpointConfig,  # noqa: F401
                       BeginEpochEvent, EndEpochEvent, BeginStepEvent,
                       EndStepEvent, save_checkpoint, load_checkpoint)

@@ -228,6 +228,19 @@ class Executor:
         scope = scope or global_scope()
         feed = feed or {}
         fetch_list = fetch_list or []
+
+        # A pserver program (one listen_and_serv op) is a HOST service, not
+        # an XLA computation: serve until stopped, exactly like the
+        # reference's blocking Executor.run on the pserver program
+        # (reference listen_and_serv_op.cc:267).
+        ls = [op for op in program.global_block().ops
+              if op.type == "listen_and_serv"]
+        if ls:
+            from ..pserver.server import ParameterServer
+            ps = ParameterServer(ls[0].attrs["endpoint"],
+                                 trainers=ls[0].attrs.get("trainers", 1))
+            ps.serve_forever()
+            return []
         fetch_names = [f.name if isinstance(f, ir.Variable) else str(f)
                        for f in fetch_list]
 

@@ -15,7 +15,10 @@ from ..param_attr import ParamAttr
 
 
 def build(num_fields=26, sparse_feature_dim=int(1e5), embedding_size=16,
-          dense_dim=13, hidden_sizes=(400, 400, 400)):
+          dense_dim=13, hidden_sizes=(400, 400, 400), distributed=False):
+    """`distributed=True` marks the embedding tables is_distributed for the
+    host parameter-server path (reference P5 distributed lookup table);
+    default False uses the GSPMD 'mp' row sharding."""
     dense_input = layers.data(name="dense_input", shape=[dense_dim],
                               dtype="float32")
     sparse_input = layers.data(name="sparse_input", shape=[num_fields],
@@ -23,12 +26,15 @@ def build(num_fields=26, sparse_feature_dim=int(1e5), embedding_size=16,
     label = layers.data(name="label", shape=[1], dtype="int64")
 
     # shared sharded embedding table: first-order (w) + second-order (v)
+    sharding = None if distributed else ("mp", None)
     emb_v = layers.embedding(
         sparse_input, size=[sparse_feature_dim, embedding_size],
-        param_attr=ParamAttr(name="fm_v", sharding=("mp", None)))  # [B,F,K]
+        is_distributed=distributed,
+        param_attr=ParamAttr(name="fm_v", sharding=sharding))  # [B,F,K]
     emb_w = layers.embedding(
         sparse_input, size=[sparse_feature_dim, 1],
-        param_attr=ParamAttr(name="fm_w", sharding=("mp", None)))  # [B,F,1]
+        is_distributed=distributed,
+        param_attr=ParamAttr(name="fm_w", sharding=sharding))  # [B,F,1]
 
     # FM first order
     first_order = layers.reduce_sum(emb_w, dim=[1, 2], keep_dim=False)

@@ -1,0 +1,10 @@
+"""Host parameter-server runtime: async (barrierless) updates + distributed
+sparse lookup tables — the two reference capabilities with no XLA-collective
+analog (reference: listen_and_serv_op.cc RunAsyncLoop :195,
+doc/fluid/design/dist_train/distributed_lookup_table_design.md).
+Sync modes never come here: they collapse to GSPMD collectives
+(transpiler/distribute_transpiler.py)."""
+
+from .server import ParameterServer  # noqa: F401
+from .client import PSClient  # noqa: F401
+from .trainer import AsyncPSTrainer  # noqa: F401

@@ -1,0 +1,160 @@
+"""Trainer-side parameter-server client + async train-step driver.
+
+Capability parity with the reference trainer-side distributed ops
+(reference: paddle/fluid/operators/send_op.cc:28, recv_op.cc, prefetch op,
+operators/distributed/grpc_client.cc AsyncSendVar :66 / AsyncGetVar :122 /
+AsyncPrefetchVar; split_ids/merge_ids ops for the sparse path;
+python/paddle/fluid/transpiler/distribute_transpiler.py:316
+`_replace_lookup_table_op_with_prefetch`).
+
+TPU-native redesign: RPC cannot happen inside a jitted XLA step, so the
+send/recv/prefetch ops become HOST-side phases around the compiled step:
+
+    pull params -> [jitted fwd+bwd on TPU] -> push grads     (async, P3)
+    prefetch rows -> [jitted step on gathered sub-table] -> push row grads (P5)
+
+The compiled step itself is unchanged pure XLA — exactly the split the
+reference makes between compute ops and distributed ops, relocated to the
+host boundary where TPUs require it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import rpc
+
+
+class PSClient:
+    """Connection pool + typed calls to a set of parameter servers."""
+
+    def __init__(self, endpoints: Sequence[str]):
+        self.endpoints = list(endpoints)
+        self._socks = {}
+        self._lock = threading.Lock()
+        self._ep_locks: Dict[str, threading.Lock] = {}
+
+    def _sock(self, endpoint):
+        with self._lock:
+            if endpoint not in self._socks:
+                self._socks[endpoint] = rpc.connect(endpoint)
+            return self._socks[endpoint]
+
+    def _call(self, endpoint, cmd, **payload):
+        with self._lock:
+            ep_lock = self._ep_locks.setdefault(endpoint, threading.Lock())
+        with ep_lock:  # one in-flight request per connection
+            sock = self._sock(endpoint)
+            rpc.send_msg(sock, (cmd, payload))
+            status, value = rpc.recv_msg(sock)
+        if status != "ok":
+            raise RuntimeError(f"pserver {endpoint} {cmd}: {value}")
+        return value
+
+    # -- dense ------------------------------------------------------------
+    def init_param(self, endpoint, name, value, opt_type, lr, attrs):
+        self._call(endpoint, "init_param", name=name,
+                   value=np.asarray(value), opt_type=opt_type, lr=lr,
+                   attrs=attrs)
+
+    def get_param(self, endpoint, name) -> np.ndarray:
+        return self._call(endpoint, "get_param", name=name)
+
+    def push_grad(self, endpoint, name, grad):
+        self._call(endpoint, "push_grad", name=name, grad=np.asarray(grad))
+
+    def get_params_parallel(self, by_ep: Dict[str, List[str]]
+                            ) -> Dict[str, Dict[str, np.ndarray]]:
+        """One batched get per endpoint, endpoints in parallel (reference
+        AsyncGetVar overlap, grpc_client.cc:122)."""
+        from concurrent.futures import ThreadPoolExecutor
+        if len(by_ep) <= 1:
+            return {ep: self._call(ep, "get_params", names=names)
+                    for ep, names in by_ep.items()}
+        with ThreadPoolExecutor(max_workers=len(by_ep)) as pool:
+            futs = {ep: pool.submit(self._call, ep, "get_params", names=names)
+                    for ep, names in by_ep.items()}
+            return {ep: f.result() for ep, f in futs.items()}
+
+    def push_grads_parallel(self, by_ep: Dict[str, Dict[str, np.ndarray]]):
+        """One batched push per endpoint, endpoints in parallel (reference
+        AsyncSendVar overlap, grpc_client.cc:66)."""
+        from concurrent.futures import ThreadPoolExecutor
+        if len(by_ep) <= 1:
+            for ep, grads in by_ep.items():
+                self._call(ep, "push_grads", grads=grads)
+            return
+        with ThreadPoolExecutor(max_workers=len(by_ep)) as pool:
+            futs = [pool.submit(self._call, ep, "push_grads", grads=grads)
+                    for ep, grads in by_ep.items()]
+            for f in futs:
+                f.result()
+
+    # -- sparse -------------------------------------------------------------
+    def init_table(self, name, rows, width, dtype, init_low, init_high,
+                   seed, opt_type, lr, attrs):
+        """Create the row shard on every server (id % n_servers sharding)."""
+        n = len(self.endpoints)
+        for i, ep in enumerate(self.endpoints):
+            local_rows = (rows - i + n - 1) // n  # rows with id % n == i
+            self._call(ep, "init_table", name=name, local_rows=local_rows,
+                       width=width, dtype=dtype, init_low=init_low,
+                       init_high=init_high, seed=seed + i, opt_type=opt_type,
+                       lr=lr, attrs=attrs)
+
+    def prefetch_rows(self, name, ids: np.ndarray) -> np.ndarray:
+        """Fetch rows for GLOBAL ids: split by id % n (reference
+        split_ids_op), prefetch each shard, merge back in input order
+        (reference merge_ids_op)."""
+        ids = np.asarray(ids).reshape(-1)
+        n = len(self.endpoints)
+        out: Optional[np.ndarray] = None
+        for i, ep in enumerate(self.endpoints):
+            mask = (ids % n) == i
+            if not mask.any():
+                continue
+            local = ids[mask] // n
+            rows = self._call(ep, "prefetch", name=name, local_ids=local)
+            if out is None:
+                out = np.empty((ids.shape[0], rows.shape[1]), rows.dtype)
+            out[mask] = rows
+        return out
+
+    def push_sparse_grad(self, name, ids: np.ndarray, row_grads: np.ndarray):
+        ids = np.asarray(ids).reshape(-1)
+        n = len(self.endpoints)
+        for i, ep in enumerate(self.endpoints):
+            mask = (ids % n) == i
+            if not mask.any():
+                continue
+            self._call(ep, "push_sparse_grad", name=name,
+                       local_ids=ids[mask] // n,
+                       row_grads=np.asarray(row_grads)[mask])
+
+    # -- control ------------------------------------------------------------
+    def barrier(self):
+        for ep in self.endpoints:
+            self._call(ep, "batch_barrier")
+
+    def save(self, dirname):
+        return [self._call(ep, "save", dirname=dirname)
+                for ep in self.endpoints]
+
+    def stop_all(self):
+        for ep in self.endpoints:
+            try:
+                self._call(ep, "stop")
+            except (RuntimeError, ConnectionError, OSError):
+                pass
+
+    def close(self):
+        with self._lock:
+            for s in self._socks.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._socks.clear()

@@ -1,0 +1,86 @@
+"""Socket RPC for the host parameter-server runtime.
+
+Capability parity with the reference's gRPC transport (reference:
+paddle/fluid/operators/distributed/grpc_client.cc:66-329,
+grpc_server.cc:82-415, send_recv.proto.in:20-40 `VariableMessage`).
+
+TPU-native rationale: XLA collectives cover every *synchronous* distribution
+mode, but the barrierless parameter-server mode (RunAsyncLoop,
+listen_and_serv_op.cc:195) and the distributed sparse lookup table have no
+collective analog — they need a host-side service. The reference vendors
+gRPC+protobuf for this; here the wire format is length-prefixed pickles of
+(cmd, payload) tuples over TCP — numpy arrays serialize zero-copy via
+pickle protocol 5 buffers, and the stdlib socket layer keeps the runtime
+dependency-free.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import socket
+import struct
+from typing import Any, Tuple
+
+_HDR = struct.Struct("!Q")  # 8-byte big-endian length prefix
+
+# Trust boundary: like the reference's INSECURE gRPC channels
+# (grpc_client.cc creates no credentials), this transport assumes a trusted
+# cluster network. Defense in depth: deserialization goes through a
+# restricted unpickler that only reconstructs numpy arrays/scalars and plain
+# containers, so a stray connection cannot smuggle a __reduce__ payload into
+# arbitrary code execution.
+_ALLOWED = {
+    ("numpy", "ndarray"), ("numpy", "dtype"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.numeric", "_frombuffer"),
+    ("numpy.core.numeric", "_frombuffer"),
+    ("numpy", "int32"), ("numpy", "int64"),
+    ("numpy", "float32"), ("numpy", "float64"), ("numpy", "bool_"),
+}
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if (module, name) in _ALLOWED:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"pserver wire protocol forbids {module}.{name}")
+
+
+def send_msg(sock: socket.socket, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    header = _recv_exact(sock, _HDR.size)
+    (n,) = _HDR.unpack(header)
+    return _RestrictedUnpickler(io.BytesIO(_recv_exact(sock, n))).load()
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed connection mid-message")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def parse_endpoint(endpoint: str) -> Tuple[str, int]:
+    host, port = endpoint.rsplit(":", 1)
+    return host or "127.0.0.1", int(port)
+
+
+def connect(endpoint: str, timeout: float = 30.0) -> socket.socket:
+    host, port = parse_endpoint(endpoint)
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock

@@ -1,0 +1,233 @@
+"""Host parameter server: sharded dense params + sparse tables, barrierless
+async updates.
+
+Capability parity with the reference pserver runtime (reference:
+paddle/fluid/operators/listen_and_serv_op.cc — RunSyncLoop :106,
+RunAsyncLoop :195 (per-grad update, no barriers);
+operators/distributed/request_handler_impl.cc RequestSend/Get/Prefetch;
+lookup_sparse_table_op.cc:39 auto-grown uniform-init sparse rows;
+checkpoint_notify handling).
+
+TPU-native redesign: the trainer's compute step stays ONE jitted XLA
+program; only the parameter exchange crosses the host boundary. Each server
+process owns a shard of the dense params (round-robin by name, reference
+ps_dispatcher) and a row shard of each sparse table (row id % num_servers,
+reference split_ids_op semantics). `push_grad` applies the update
+immediately under a per-param lock — the reference's barrierless async SGD
+(doc/fluid/design/dist_train/async_update.md).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from . import rpc
+from .optim import make_optimizer
+
+logger = logging.getLogger(__name__)
+
+
+class _SparseTable:
+    """A row shard of a distributed lookup table.
+
+    Memory contract: the shard is an EAGER dense [rows/n_servers, width]
+    array (plus same-shape optimizer accumulators on first push) — 2-3x the
+    shard bytes per server. All rows are uniform-initialized up front, which
+    matches the reference's lookup_sparse_table numerics (uniform min/max,
+    lookup_sparse_table_op.cc:39) without its auto-grow bookkeeping. For
+    vocabularies too large for dense shards, the upgrade path is a hashed
+    row-dict (the reference's SelectedRows row map) — not needed at the
+    scales the in-tree workloads exercise."""
+
+    def __init__(self, local_rows: int, width: int, dtype: str,
+                 init_low: float, init_high: float, seed: int):
+        rng = np.random.RandomState(seed)
+        self.value = rng.uniform(init_low, init_high,
+                                 (local_rows, width)).astype(dtype)
+
+    def get(self, local_ids: np.ndarray) -> np.ndarray:
+        return self.value[local_ids]
+
+
+class ParameterServer:
+    def __init__(self, endpoint: str, trainers: int = 1):
+        self.endpoint = endpoint
+        self.trainers = trainers
+        self._dense: Dict[str, np.ndarray] = {}
+        self._sparse: Dict[str, _SparseTable] = {}
+        self._optim: Dict[str, object] = {}
+        self._locks: Dict[str, threading.Lock] = {}
+        self._global_lock = threading.Lock()
+        self._barrier = threading.Barrier(trainers) if trainers > 1 else None
+        self._listener: Optional[socket.socket] = None
+        self._threads = []
+        self._stop = threading.Event()
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "ParameterServer":
+        host, port = rpc.parse_endpoint(self.endpoint)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        if port == 0:  # ephemeral port support for tests
+            self.endpoint = f"{host}:{self._listener.getsockname()[1]}"
+        self._listener.listen(64)
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name=f"pserver@{self.endpoint}")
+        t.start()
+        self._threads.append(t)
+        logger.info("pserver listening on %s", self.endpoint)
+        return self
+
+    def serve_forever(self):
+        self.start()
+        self._stop.wait()
+
+    def stop(self):
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # connection threads are daemonic and untracked (tracking them
+            # would leak one Thread object per reconnect on a long-lived
+            # server)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn):
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = rpc.recv_msg(conn)
+                except (ConnectionError, EOFError, OSError):
+                    return
+                cmd, payload = msg
+                try:
+                    reply = self._dispatch(cmd, payload)
+                except Exception as e:  # surface server errors to the client
+                    reply = ("err", f"{type(e).__name__}: {e}")
+                rpc.send_msg(conn, reply)
+                if cmd == "stop":
+                    return
+        finally:
+            conn.close()
+
+    # -- dispatch ---------------------------------------------------------
+    def _dispatch(self, cmd, p):
+        handler = getattr(self, f"_h_{cmd}", None)
+        if handler is None:
+            raise ValueError(f"unknown pserver command {cmd!r}")
+        return handler(**p)
+
+    def _lock(self, name):
+        with self._global_lock:
+            return self._locks.setdefault(name, threading.Lock())
+
+    # -- dense params -----------------------------------------------------
+    def _h_init_param(self, name, value, opt_type, lr, attrs):
+        """Idempotent: first writer wins (trainer 0 pushes startup values,
+        reference BCastParamsToDevices / pserver startup program analog)."""
+        with self._lock(name):
+            if name not in self._dense:
+                self._dense[name] = np.array(value, copy=True)
+                self._optim[name] = make_optimizer(opt_type, lr, attrs)
+        return ("ok", None)
+
+    def _h_get_param(self, name):
+        with self._lock(name):
+            if name not in self._dense:
+                return ("err", f"param {name!r} not initialized")
+            return ("ok", self._dense[name].copy())
+
+    def _h_push_grad(self, name, grad):
+        """Barrierless: apply immediately (RunAsyncLoop semantics)."""
+        with self._lock(name):
+            self._optim[name].dense(self._dense[name], np.asarray(grad))
+        return ("ok", None)
+
+    def _h_get_params(self, names):
+        out = {}
+        for n in names:
+            with self._lock(n):
+                if n not in self._dense:
+                    return ("err", f"param {n!r} not initialized")
+                out[n] = self._dense[n].copy()
+        return ("ok", out)
+
+    def _h_push_grads(self, grads):
+        for n, g in grads.items():
+            with self._lock(n):
+                self._optim[n].dense(self._dense[n], np.asarray(g))
+        return ("ok", None)
+
+    # -- sparse tables ----------------------------------------------------
+    def _h_init_table(self, name, local_rows, width, dtype, init_low,
+                      init_high, seed, opt_type, lr, attrs):
+        with self._lock(name):
+            if name not in self._sparse:
+                self._sparse[name] = _SparseTable(local_rows, width, dtype,
+                                                  init_low, init_high, seed)
+                self._optim[name] = make_optimizer(opt_type, lr, attrs)
+        return ("ok", None)
+
+    def _h_prefetch(self, name, local_ids):
+        """Row fetch by LOCAL ids (client did the id%N sharding split,
+        reference prefetch op + split_ids_op)."""
+        with self._lock(name):
+            return ("ok", self._sparse[name].get(np.asarray(local_ids)))
+
+    def _h_push_sparse_grad(self, name, local_ids, row_grads):
+        with self._lock(name):
+            table = self._sparse[name]
+            self._optim[name].sparse(table.value, np.asarray(local_ids),
+                                     np.asarray(row_grads))
+        return ("ok", None)
+
+    # -- sync-mode barrier (reference RunSyncLoop batch barrier) -----------
+    def _h_batch_barrier(self):
+        if self._barrier is not None:
+            self._barrier.wait()
+        return ("ok", None)
+
+    # -- checkpoint (reference checkpoint_notify -> save block) ------------
+    def _h_save(self, dirname):
+        os.makedirs(dirname, exist_ok=True)
+        # snapshot each param under its own lock so a checkpoint racing
+        # concurrent pushes is internally consistent per-param (the async
+        # mode has no global consistent cut — same as the reference)
+        shard = {}
+        for n in list(self._dense):
+            with self._lock(n):
+                shard[n] = self._dense[n].copy()
+        for n in list(self._sparse):
+            with self._lock(n):
+                shard[n] = self._sparse[n].value.copy()
+        path = os.path.join(
+            dirname, f"pserver_{self.endpoint.replace(':', '_')}.npz")
+        np.savez(path, **shard)
+        return ("ok", path)
+
+    def _h_stats(self):
+        return ("ok", {"dense": sorted(self._dense),
+                       "sparse": sorted(self._sparse),
+                       "endpoint": self.endpoint})
+
+    def _h_stop(self):
+        self.stop()
+        return ("ok", None)

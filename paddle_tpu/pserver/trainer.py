@@ -1,0 +1,165 @@
+"""AsyncPSTrainer: drives a transpiled trainer program against pservers.
+
+Capability parity with the reference's async trainer loop (reference:
+trainer program send/recv ops injected by distribute_transpiler.py:248-309;
+async update design doc/fluid/design/dist_train/async_update.md; sparse
+prefetch path distribute_transpiler.py:316 + split_ids/merge_ids ops).
+
+TPU-native redesign: the jitted step cannot issue RPCs, so each reference
+distributed op becomes a host phase around `exe.run`:
+
+    recv ops      -> pull dense params into the scope before the step
+    prefetch op   -> fetch the batch's unique table rows, feed them as a
+                     [cap, width] sub-table UNDER THE TABLE'S NAME with ids
+                     remapped to sub-table rows (feeds override scope state,
+                     and the executor compiles per feed signature, so the
+                     program needs no rewriting)
+    send ops      -> push dense grads + scatter sub-table row grads after
+                     the step (barrierless — RunAsyncLoop semantics)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core import executor as core_exec
+from .client import PSClient
+
+# lazily-initialized sparse rows are uniform in this range (reference
+# lookup_sparse_table_op.cc min/max attrs default -1/1; embeddings converge
+# better from a tighter band)
+TABLE_INIT_LOW, TABLE_INIT_HIGH = -0.05, 0.05
+
+
+class AsyncPSTrainer:
+    def __init__(self, transpiler, exe, program=None, scope=None):
+        self.t = transpiler
+        self.exe = exe
+        self.scope = scope or core_exec.global_scope()
+        self.program = program or transpiler.get_trainer_program()
+        self.client = PSClient(transpiler._pserver_endpoints)
+        self.trainer_id = transpiler._trainer_id
+        # tables sharing any ids feed must share one uniq/remap (a fed ids
+        # var can only hold ONE remapping) — group them transitively
+        self._table_groups = self._group_tables(transpiler.sparse_specs)
+
+    @staticmethod
+    def _group_tables(sparse_specs):
+        groups: List[dict] = []  # {"tables": [...], "ids_names": [...]}
+        for wname, spec in sparse_specs.items():
+            hit = None
+            for g in groups:
+                if set(spec["ids_names"]) & set(g["ids_names"]):
+                    hit = g
+                    break
+            if hit is None:
+                hit = {"tables": [], "ids_names": []}
+                groups.append(hit)
+            hit["tables"].append(wname)
+            for n in spec["ids_names"]:
+                if n not in hit["ids_names"]:
+                    hit["ids_names"].append(n)
+        # merge transitively-overlapping groups
+        merged = True
+        while merged:
+            merged = False
+            for i in range(len(groups)):
+                for j in range(i + 1, len(groups)):
+                    if set(groups[i]["ids_names"]) & set(groups[j]["ids_names"]):
+                        groups[i]["tables"] += groups[j]["tables"]
+                        groups[i]["ids_names"] += [
+                            n for n in groups[j]["ids_names"]
+                            if n not in groups[i]["ids_names"]]
+                        del groups[j]
+                        merged = True
+                        break
+                if merged:
+                    break
+        return groups
+
+    # -- startup ----------------------------------------------------------
+    def _lr_of(self, spec) -> float:
+        name = spec.get("lr_name")
+        if name is None:
+            return 0.01
+        v = self.scope.find_var(name)
+        return float(np.asarray(v).reshape(-1)[0]) if v is not None else 0.01
+
+    def init_params(self):
+        """Every trainer offers its startup values; the server keeps the
+        first writer's (reference: pserver startup program / param bcast)."""
+        for pname, spec in self.t.param_specs.items():
+            value = np.asarray(self.scope.find_var(pname))
+            self.client.init_param(spec["endpoint"], pname, value,
+                                   spec["opt_type"], self._lr_of(spec),
+                                   spec["attrs"])
+        for wname, spec in self.t.sparse_specs.items():
+            self.client.init_table(
+                wname, spec["rows"], spec["width"], spec["dtype"],
+                TABLE_INIT_LOW, TABLE_INIT_HIGH, seed=1337,
+                opt_type=spec["opt_type"], lr=self._lr_of(spec),
+                attrs=spec["attrs"])
+
+    # -- one async step ---------------------------------------------------
+    def step(self, feed: Dict, fetch_list: Sequence) -> List[np.ndarray]:
+        # 1. recv: freshest dense params — ONE batched RPC per endpoint, in
+        # parallel (reference overlaps AsyncGetVar handles the same way)
+        by_ep: Dict[str, List[str]] = {}
+        for pname, spec in self.t.param_specs.items():
+            by_ep.setdefault(spec["endpoint"], []).append(pname)
+        for ep, values in self.client.get_params_parallel(by_ep).items():
+            for pname, value in values.items():
+                self.scope.set_var(pname, value)
+
+        # 2. prefetch: per table GROUP (tables sharing an ids feed share one
+        # uniq/remap — the fed ids var can only hold one mapping)
+        feed = dict(feed)
+        pushes = []  # (wname, unique_ids[m])
+        for g in self._table_groups:
+            ids_vals = [np.asarray(feed[n]) for n in g["ids_names"]]
+            flat = np.concatenate([v.reshape(-1) for v in ids_vals])
+            uniq, inv = np.unique(flat, return_inverse=True)
+            m = uniq.shape[0]
+            for wname in g["tables"]:
+                spec = self.t.sparse_specs[wname]
+                if m > spec["cap"]:
+                    raise ValueError(
+                        f"batch touches {m} unique rows of {wname!r} but "
+                        f"sparse_prefetch_cap={spec['cap']}; raise "
+                        f"DistributeTranspilerConfig.sparse_prefetch_cap")
+                sub = np.zeros((spec["cap"], spec["width"]),
+                               dtype=spec["dtype"])
+                sub[:m] = self.client.prefetch_rows(wname, uniq)
+                feed[wname] = sub
+                pushes.append((wname, uniq))
+            off = 0
+            for n, v in zip(g["ids_names"], ids_vals):
+                feed[n] = inv[off:off + v.size].reshape(v.shape).astype(v.dtype)
+                off += v.size
+
+        # 3. the jitted step, fetching user targets + every grad
+        grad_fetches = [self.t.grad_names[p] for p in self.t.param_specs]
+        grad_fetches += [self.t.grad_names[w] for w, _ in pushes]
+        outs = self.exe.run(self.program, feed=feed,
+                            fetch_list=list(fetch_list) + grad_fetches)
+        user_outs = outs[: len(fetch_list)]
+        grads = outs[len(fetch_list):]
+
+        # 4. send: barrierless pushes, batched per endpoint
+        dense_by_ep: Dict[str, Dict[str, np.ndarray]] = {}
+        for (pname, spec), g in zip(self.t.param_specs.items(), grads):
+            dense_by_ep.setdefault(spec["endpoint"], {})[pname] = g
+        self.client.push_grads_parallel(dense_by_ep)
+        for (wname, uniq), g in zip(pushes,
+                                    grads[len(self.t.param_specs):]):
+            self.client.push_sparse_grad(wname, uniq, g[: uniq.shape[0]])
+        return user_outs
+
+    def save(self, dirname):
+        """checkpoint_notify analog: every pserver snapshots its shard."""
+        return self.client.save(dirname)
+
+    def close(self):
+        self.client.close()

@@ -29,10 +29,17 @@ over a (possibly multi-host) device mesh:
 from __future__ import annotations
 
 import os
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from ..core import ir
 from .ps_dispatcher import RoundRobin
+
+# op types that the host pserver can run as its per-param optimize "block"
+# (reference get_pserver_program builds one optimize sub-block per param,
+# distribute_transpiler.py:333; kernels in paddle_tpu/pserver/optim.py)
+OPTIMIZE_OP_TYPES = ("sgd", "momentum", "adam", "adamax", "adagrad",
+                     "decayed_adagrad", "adadelta", "rmsprop", "ftrl",
+                     "proximal_gd", "proximal_adagrad")
 
 
 class DistributeTranspilerConfig:
@@ -46,6 +53,10 @@ class DistributeTranspilerConfig:
     mode = "nccl2"  # every sync mode collapses to collectives on TPU
     # TPU extension: shard embedding tables with >= this many rows
     distributed_lookup_threshold = 100_000
+    # static row budget for the per-batch prefetched sub-table (the XLA step
+    # needs static shapes; reference prefetch fetched exactly the batch's
+    # unique ids — here they are padded to this cap)
+    sparse_prefetch_cap = 2048
 
 
 class DistributeTranspiler:
@@ -54,22 +65,92 @@ class DistributeTranspiler:
         self._trainer_id = 0
         self._trainers = 1
         self._program: Optional[ir.Program] = None
+        self.sync_mode = True
+        # async-mode plan, consumed by pserver.AsyncPSTrainer and
+        # get_pserver_program
+        self.param_specs: Dict[str, dict] = {}   # dense: name -> spec
+        self.sparse_specs: Dict[str, dict] = {}  # table name -> spec
+        self.grad_names: Dict[str, str] = {}     # param -> grad var name
 
     def transpile(self, trainer_id, program=None, pservers="", trainers=1,
                   sync_mode=True, startup_program=None):
-        if not sync_mode:
-            raise NotImplementedError(
-                "async (barrierless) update mode has no XLA-collective analog;"
-                " it requires the host parameter-server service (planned) — "
-                "use sync_mode=True, which matches reference nccl2/sync-pserver"
-                " semantics via GSPMD all-reduce")
         self._trainer_id = trainer_id
         self._trainers = trainers if isinstance(trainers, int) \
             else len(trainers.split(","))
         self._program = program or ir.default_main_program()
         self._pserver_endpoints = [e for e in pservers.split(",") if e]
-        self._annotate_distributed_tables()
+        self.sync_mode = sync_mode
+        if sync_mode:
+            self._annotate_distributed_tables()
+        else:
+            if not self._pserver_endpoints:
+                raise ValueError("async mode needs pservers='host:port,...'")
+            self._build_async_plan()
         return self
+
+    # ------------------------------------------------------------------
+    # async (barrierless) mode: host parameter-server plan
+    # (reference: RunAsyncLoop listen_and_serv_op.cc:195 — per-grad
+    # updates, no barriers; trainer send/recv become host-side phases
+    # around the jitted step, pserver/client.py)
+    # ------------------------------------------------------------------
+    def _build_async_plan(self):
+        block = self._program.global_block()
+        dispatcher = self.config.split_method(self._pserver_endpoints)
+
+        # 1. distributed lookup tables (their params skip the dense path).
+        # No IR rewrite is needed — the executor compiles per feed signature
+        # and feeds override scope state, so AsyncPSTrainer feeds the
+        # prefetched [cap, width] sub-table under the TABLE'S OWN NAME with
+        # batch ids remapped to sub-table rows. Gradients (incl. fan-in sums
+        # when a table is looked up twice) then flow to `W@GRAD` with the
+        # sub-table's shape automatically. This is the reference's prefetch
+        # rewrite (:316) relocated to the host feed boundary.
+        sparse_params = set()
+        cap = self.config.sparse_prefetch_cap
+        for op in block.ops:
+            if op.type != "lookup_table" or not op.attrs.get("is_distributed"):
+                continue
+            wname = op.input("W")[0]
+            w = block._find_var_recursive(wname)
+            ids_name = op.input("Ids")[0]
+            sparse_params.add(wname)
+            spec = self.sparse_specs.setdefault(wname, {
+                "rows": int(w.shape[0]), "width": int(w.shape[1]),
+                "dtype": w.dtype, "cap": cap,
+                "ids_names": [], "opt_type": None, "lr_name": None,
+                "attrs": {},
+            })
+            if ids_name not in spec["ids_names"]:
+                spec["ids_names"].append(ids_name)
+
+        # 2. find + strip optimizer ops; record per-param server specs.
+        keep_ops = []
+        for op in block.ops:
+            if op.type not in OPTIMIZE_OP_TYPES:
+                keep_ops.append(op)
+                continue
+            pname = op.input("Param")[0]
+            gname = op.input("Grad")[0]
+            lr_name = (op.input("LearningRate") or [None])[0]
+            self.grad_names[pname] = gname
+            if pname in sparse_params:
+                self.sparse_specs[pname].update(
+                    opt_type=op.type, lr_name=lr_name, attrs=dict(op.attrs))
+                continue  # table updates go through push_sparse_grad
+            self.param_specs[pname] = {
+                "opt_type": op.type, "lr_name": lr_name,
+                "attrs": dict(op.attrs),
+                "endpoint": dispatcher.dispatch_one(pname),
+            }
+        block.ops[:] = keep_ops
+        self._program._bump()
+
+        for wname, spec in self.sparse_specs.items():
+            if spec["opt_type"] is None:
+                raise ValueError(
+                    f"distributed table {wname!r} has no optimizer op — call "
+                    f"optimizer.minimize before transpile (reference order)")
 
     def _annotate_distributed_tables(self):
         """Shard big embeddings over 'mp' rows — the distributed-lookup-table
@@ -89,17 +170,31 @@ class DistributeTranspiler:
         self._program._bump()
 
     def get_trainer_program(self, wait_port=True) -> ir.Program:
-        """The trainer program IS the original program: collectives are
-        inserted by GSPMD at compile time, not by op rewriting."""
+        """Sync mode: the trainer program IS the original program —
+        collectives are inserted by GSPMD at compile time. Async mode: the
+        program with optimizer ops stripped (updates run on the pservers);
+        drive it with pserver.AsyncPSTrainer, which adds the host-side
+        pull/push phases the reference expressed as send/recv ops."""
         return self._program
 
     def get_pserver_program(self, endpoint) -> ir.Program:
-        raise NotImplementedError(
-            "TPU deployment has no parameter-server processes: parameters "
-            "live sharded/replicated in chip HBM and updates run inside the "
-            "compiled step. Launch every host with the same trainer program "
-            "(see paddle_tpu.distributed.init) — reference "
-            "get_pserver_program has no analog")
+        """Async mode: a program holding one `listen_and_serv` op (reference
+        listen_and_serv_op.cc); `Executor.run` on it blocks serving. Sync
+        mode has no pserver processes on TPU (GSPMD owns the exchange)."""
+        if self.sync_mode:
+            raise NotImplementedError(
+                "sync mode on TPU has no parameter-server processes: "
+                "parameters live sharded/replicated in chip HBM and updates "
+                "run inside the compiled step (GSPMD all-reduce). Use "
+                "sync_mode=False for the host pserver runtime")
+        prog = ir.Program()
+        # the server is generic: params/tables arrive via init_param /
+        # init_table RPCs from the trainers (first writer wins), so the op
+        # carries only what the service loop consumes
+        prog.global_block().append_op(
+            "listen_and_serv",
+            attrs={"endpoint": endpoint, "trainers": self._trainers})
+        return prog
 
     def get_pserver_programs(self, endpoint):
         return self.get_pserver_program(endpoint)

@@ -23,14 +23,22 @@ class PSDispatcher:
 
 class RoundRobin(PSDispatcher):
     def dispatch(self, varlist):
-        out = []
-        for v in varlist:
-            out.append(self._eps[self._step % len(self._eps)])
-            self._step += 1
-        return out
+        return [self.dispatch_one(v) for v in varlist]
+
+    def dispatch_one(self, var):
+        ep = self._eps[self._step % len(self._eps)]
+        self._step += 1
+        return ep
 
 
 class HashName(PSDispatcher):
     def dispatch(self, varlist):
-        return [self._eps[hash(v.name if hasattr(v, "name") else str(v))
-                          % len(self._eps)] for v in varlist]
+        return [self.dispatch_one(v) for v in varlist]
+
+    def dispatch_one(self, var):
+        # stable across processes: builtin hash() is seed-randomized for
+        # strings, which would send trainer pushes and pulls of the same
+        # param to different endpoints in different processes
+        import zlib
+        name = var.name if hasattr(var, "name") else str(var)
+        return self._eps[zlib.crc32(name.encode()) % len(self._eps)]

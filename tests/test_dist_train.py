@@ -1,0 +1,147 @@
+"""Multi-process distributed training harness: 2 pserver + 2 trainer
+subprocesses on localhost, async DeepFM (reference:
+python/paddle/fluid/tests/unittests/test_dist_base.py:23-135 —
+start_pserver :30, _wait_ps_ready :45, trainer launch :104, SIGKILL
+teardown :135; workload: dist_se_resnext/dist_transformer analogs)."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PSERVER_SCRIPT = """
+import os, sys
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.models import deepfm
+
+endpoint = sys.argv[1]
+all_eps = sys.argv[2]
+
+feeds, outs = deepfm.build(num_fields=6, sparse_feature_dim=500,
+                           embedding_size=8, dense_dim=4,
+                           hidden_sizes=(32, 32), distributed=True)
+fluid.optimizer.Adagrad(learning_rate=0.05).minimize(outs["loss"])
+t = fluid.DistributeTranspiler()
+t.transpile(trainer_id=0, pservers=all_eps, trainers=2, sync_mode=False)
+prog = t.get_pserver_program(endpoint)
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(prog)  # blocks serving (listen_and_serv)
+"""
+
+TRAINER_SCRIPT = """
+import os, sys
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.models import deepfm
+from paddle_tpu.pserver import AsyncPSTrainer
+
+trainer_id = int(sys.argv[1])
+all_eps = sys.argv[2]
+out_path = sys.argv[3]
+
+np.random.seed(100 + trainer_id)
+feeds, outs = deepfm.build(num_fields=6, sparse_feature_dim=500,
+                           embedding_size=8, dense_dim=4,
+                           hidden_sizes=(32, 32), distributed=True)
+loss = outs["loss"]
+fluid.optimizer.Adagrad(learning_rate=0.05).minimize(loss)
+cfg = fluid.DistributeTranspilerConfig()
+cfg.sparse_prefetch_cap = 256
+t = fluid.DistributeTranspiler(cfg)
+t.transpile(trainer_id=trainer_id, pservers=all_eps, trainers=2,
+            sync_mode=False)
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(fluid.default_startup_program())
+tr = AsyncPSTrainer(t, exe)
+tr.init_params()
+
+def batch(n=32):
+    ids = np.random.randint(0, 500, size=(n, 6)).astype(np.int64)
+    magic = (ids < 25).any(axis=1)
+    dense = np.random.randn(n, 4).astype(np.float32) * 0.1
+    return {"dense_input": dense, "sparse_input": ids,
+            "label": magic.astype(np.int64).reshape(n, 1)}
+
+losses = []
+for step in range(40):
+    l, = tr.step(batch(), fetch_list=[loss])
+    losses.append(float(np.asarray(l).reshape(-1)[0]))
+with open(out_path, "w") as f:
+    f.write(",".join(str(v) for v in losses))
+tr.close()
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_ps_ready(endpoints, timeout=60):
+    """Poll until every pserver accepts connections (reference
+    _wait_ps_ready polls /proc; direct connect is more robust)."""
+    deadline = time.time() + timeout
+    for ep in endpoints:
+        host, port = ep.rsplit(":", 1)
+        while True:
+            try:
+                socket.create_connection((host, int(port)), timeout=1).close()
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise TimeoutError(f"pserver {ep} never came up")
+                time.sleep(0.3)
+
+
+def _spawn(code, args, env):
+    return subprocess.Popen([sys.executable, "-c", code] + args,
+                            env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE)
+
+
+def test_async_pserver_deepfm_two_trainers(tmp_path):
+    eps = [f"127.0.0.1:{_free_port()}" for _ in range(2)]
+    all_eps = ",".join(eps)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+
+    pservers = [_spawn(PSERVER_SCRIPT, [ep, all_eps], env) for ep in eps]
+    trainers = []
+    try:
+        _wait_ps_ready(eps)
+        out_files = [str(tmp_path / f"t{i}.txt") for i in range(2)]
+        trainers = [_spawn(TRAINER_SCRIPT, [str(i), all_eps, out_files[i]],
+                           env) for i in range(2)]
+        for i, tr in enumerate(trainers):
+            out, err = tr.communicate(timeout=240)
+            assert tr.returncode == 0, (
+                f"trainer {i} failed:\n{err.decode()[-3000:]}")
+        for i, path in enumerate(out_files):
+            losses = [float(v) for v in open(path).read().split(",")]
+            assert len(losses) == 40
+            first, last = np.mean(losses[:8]), np.mean(losses[-8:])
+            assert last < first * 0.9, (
+                f"trainer {i} did not converge: first={first} last={last}")
+    finally:
+        for p in trainers + pservers:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)  # reference teardown :135
+        for p in trainers + pservers:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass

@@ -115,6 +115,7 @@ def test_distribute_transpiler_annotates_embeddings():
     w = prog.global_block().vars["big_table"]
     assert w.sharding == ("mp", None)
     with pytest.raises(NotImplementedError):
-        t.get_pserver_program("127.0.0.1:6174")
-    with pytest.raises(NotImplementedError):
+        t.get_pserver_program("127.0.0.1:6174")  # sync mode: GSPMD, no ps
+    with pytest.raises(ValueError):
+        # async mode is the host pserver runtime and needs endpoints
         fluid.DistributeTranspiler().transpile(0, sync_mode=False)
