@@ -49,7 +49,8 @@ def append_backward(loss: ir.Variable,
         "fill_constant",
         outputs={"Out": [loss_grad.name]},
         attrs={"shape": list(loss.shape) if loss.shape else [1],
-               "dtype": loss.dtype, "value": 1.0},
+               "dtype": loss.dtype, "value": 1.0,
+               "__role__": "backward"},
     )
 
     # 2. Reverse walk emitting grad ops; collect per-var grad contributions.
@@ -138,7 +139,7 @@ def append_backward(loss: ir.Variable,
             inputs={"FwdIn": sorted({n for ns in op.inputs.values() for n in ns}),
                     "OutGrad": out_grad_names},
             outputs={"InGrad": out_names},
-            attrs={FWD_OP_ATTR: fwd_desc},
+            attrs={FWD_OP_ATTR: fwd_desc, "__role__": "backward"},
         )
         block.ops.append(grad_op)
         program._bump()
@@ -195,7 +196,8 @@ def _insert_sum_ops(block: ir.Block, contribs, loss_name: str,
         last_idx = max(i for i, op in enumerate(block.ops)
                        if id(op) in ops_in_epoch)
         block.insert_op(last_idx + 1, "sum",
-                        inputs={"X": srcs}, outputs={"Out": [canonical]})
+                        inputs={"X": srcs}, outputs={"Out": [canonical]},
+                        attrs={"__role__": "backward"})
 
 
 def _grad_needing_inputs(block, op, no_grad, parameter_list) -> List[str]:

@@ -312,9 +312,16 @@ class Program:
         return p
 
     def _set_inference_mode(self):
-        """Flip train-only attrs (dropout/batch_norm `is_test`) for eval clones."""
+        """Flip train-only attrs (dropout/batch_norm `is_test`) and drop
+        backward/optimize-role ops for eval clones (the reference strips by
+        OpRole the same way, framework.py clone/_inference_optimize —
+        without this, pruning an inference slice chases a parameter to its
+        optimizer op's ParamOut and drags the whole training graph back in)."""
         self._is_inference = True
         for blk in self.blocks:
+            blk.ops = [op for op in blk.ops
+                       if op.attrs.get("__role__") not in ("backward",
+                                                           "optimize")]
             for op in blk.ops:
                 if "is_test" in op.attrs:
                     op.attrs["is_test"] = True

@@ -625,6 +625,21 @@ def _seq_inputs(helper, x, extra=None):
     return inputs
 
 
+def _alias_seqlen(helper, src, dst):
+    """Length-preserving sequence ops (sequence_conv, row_conv, ...) carry
+    their input's @SEQLEN onto the output with an explicit assign — the
+    runtime propagation in lowering.py only walks propagate_seqlen=True ops,
+    and a downstream sequence op would otherwise read an unmaterialized
+    companion."""
+    seq_src = helper.ensure_seqlen_var(src)
+    if seq_src is None:
+        return
+    dst.lod_level = max(dst.lod_level, src.lod_level)
+    seq_dst = helper.ensure_seqlen_var(dst)
+    helper.append_op("assign", inputs={"X": [seq_src.name]},
+                     outputs={"Out": [seq_dst.name]})
+
+
 def sequence_pool(input, pool_type, is_test=False):
     helper = LayerHelper("sequence_pool")
     out = helper.create_variable_for_type_inference(dtype=input.dtype)
@@ -642,12 +657,25 @@ def sequence_last_step(input):
     return sequence_pool(input, "last")
 
 
+def cos_sim(X, Y):
+    """Row-wise cosine similarity (reference nn.py cos_sim)."""
+    helper = LayerHelper("cos_sim")
+    out = helper.create_variable_for_type_inference(dtype=X.dtype)
+    xn = helper.create_variable_for_type_inference(dtype=X.dtype)
+    yn = helper.create_variable_for_type_inference(dtype=X.dtype)
+    helper.append_op("cos_sim", inputs={"X": [X.name], "Y": [Y.name]},
+                     outputs={"Out": [out.name], "XNorm": [xn.name],
+                              "YNorm": [yn.name]})
+    return out
+
+
 def sequence_softmax(input, use_cudnn=False, name=None):
     helper = LayerHelper("sequence_softmax", name=name)
     out = helper.create_variable_for_type_inference(dtype=input.dtype)
     helper.append_op("sequence_softmax", inputs=_seq_inputs(helper, input),
                      outputs={"Out": [out.name]})
     out.lod_level = input.lod_level
+    _alias_seqlen(helper, input, out)
     return out
 
 
@@ -658,6 +686,8 @@ def sequence_expand(x, y, ref_level=-1, name=None):
                      inputs={"X": [x.name], "Y": [y.name]},
                      outputs={"Out": [out.name]}, attrs={"ref_level": ref_level})
     out.lod_level = y.lod_level
+    # the output inherits Y's time axis, so its lengths are Y's
+    _alias_seqlen(helper, y, out)
     return out
 
 
@@ -676,15 +706,24 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
                             "contextStride": filter_stride})
     out.lod_level = input.lod_level
     pre_act = _append_bias(helper, out)
-    return helper.append_activation(pre_act)
+    final = helper.append_activation(pre_act)
+    # alias onto the FINAL var: downstream sequence ops read its companion,
+    # and pruning keeps the alias only if its output is the one they read
+    _alias_seqlen(helper, input, final)
+    return final
 
 
 def sequence_reshape(input, new_dim):
     helper = LayerHelper("sequence_reshape")
     out = helper.create_variable_for_type_inference(dtype=input.dtype)
-    helper.append_op("sequence_reshape", inputs=_seq_inputs(helper, input),
-                     outputs={"Out": [out.name]}, attrs={"new_dim": new_dim})
     out.lod_level = input.lod_level
+    outputs = {"Out": [out.name]}
+    if input.lod_level > 0:
+        # lengths scale by D/new_dim — emitted by the op itself (OutLen)
+        seq_out = helper.ensure_seqlen_var(out)
+        outputs["OutLen"] = [seq_out.name]
+    helper.append_op("sequence_reshape", inputs=_seq_inputs(helper, input),
+                     outputs=outputs, attrs={"new_dim": new_dim})
     return out
 
 
@@ -698,7 +737,9 @@ def row_conv(input, future_context_size, param_attr=None, act=None):
                      inputs=_seq_inputs(helper, input, {"Filter": [w.name]}),
                      outputs={"Out": [out.name]})
     out.lod_level = input.lod_level
-    return helper.append_activation(out)
+    final = helper.append_activation(out)
+    _alias_seqlen(helper, input, final)
+    return final
 
 
 def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):

@@ -65,13 +65,22 @@ def _conv2d_transpose(ctx, Input, Filter, Bias=None):
     strides = _pair(ctx.attr("strides", [1, 1]))
     pads = _pair(ctx.attr("paddings", [0, 0]))
     dils = _pair(ctx.attr("dilations", [1, 1]))
-    out = lax.conv_transpose(
-        Input, Filter,
-        strides=strides,
-        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+    # Gradient-of-conv expressed directly: stride becomes lhs (input)
+    # dilation, padding p becomes (k_eff-1-p) of the spatially-flipped
+    # kernel, giving out = (in-1)*s + k_eff - 2p — the reference formula
+    # (conv_transpose_op.cc). Filter stays in the reference [in_c, out_c,
+    # H, W] layout ("IOHW"). Validated bit-exact (f64) against torch
+    # conv_transpose2d over k/p/s/dilation combinations; lax.conv_transpose
+    # was NOT used because its padding semantics diverge for k-1 != 2p.
+    k_eff = [dils[i] * (Filter.shape[2 + i] - 1) + 1 for i in (0, 1)]
+    out = lax.conv_general_dilated(
+        Input, jnp.flip(Filter, axis=(2, 3)),
+        window_strides=(1, 1),
+        padding=[(k_eff[0] - 1 - pads[0], k_eff[0] - 1 - pads[0]),
+                 (k_eff[1] - 1 - pads[1], k_eff[1] - 1 - pads[1])],
+        lhs_dilation=strides,
         rhs_dilation=dils,
         dimension_numbers=("NCHW", "IOHW", "NCHW"),
-        transpose_kernel=True,
     )
     if Bias is not None:
         out = out + Bias.reshape((1, -1, 1, 1))

@@ -75,10 +75,16 @@ def _sequence_expand(ctx, X, Y, SeqLen=None):
 
 @register_op("sequence_reshape", propagate_seqlen=False)
 def _sequence_reshape(ctx, X, SeqLen=None):
+    """Repack [B,T,D] -> [B, T*D/new_dim, new_dim]; row lengths scale by
+    D/new_dim (reference sequence_reshape_op.cc recomputes the LoD the same
+    way and requires len*D % new_dim == 0)."""
     new_dim = ctx.attr("new_dim")
     B, T, D = X.shape
     assert (T * D) % new_dim == 0
-    return {"Out": X.reshape(B, (T * D) // new_dim, new_dim)}
+    outs = {"Out": X.reshape(B, (T * D) // new_dim, new_dim)}
+    if SeqLen is not None:
+        outs["OutLen"] = (SeqLen * D) // new_dim
+    return outs
 
 
 @register_op("sequence_concat", propagate_seqlen=False)

@@ -101,6 +101,8 @@ class Optimizer:
                  no_grad_set=None):
         """append_backward + regularization + clip + update ops
         (reference optimizer.py:245)."""
+        block = loss.block.program.global_block()
+        n0 = len(block.ops)
         params_grads = append_backward(loss, parameter_list=parameter_list,
                                        no_grad_set=no_grad_set)
         params_grads = append_gradient_clip_ops(params_grads)
@@ -108,6 +110,11 @@ class Optimizer:
                                                  self.regularization)
         optimize_ops = self._create_optimization_pass(params_grads, loss,
                                                       startup_program)
+        # role-tag everything minimize appended (clip/reg/lr/update ops);
+        # grad ops were already tagged "backward" by append_backward. Eval
+        # clones strip by role (ir._set_inference_mode).
+        for op in block.ops[n0:]:
+            op.attrs.setdefault("__role__", "optimize")
         return optimize_ops, params_grads
 
     def _lr_for_param(self, param):

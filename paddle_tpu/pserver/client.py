@@ -36,6 +36,11 @@ class PSClient:
         self._socks = {}
         self._lock = threading.Lock()
         self._ep_locks: Dict[str, threading.Lock] = {}
+        # persistent pool: the parallel get/push run on every training step
+        from concurrent.futures import ThreadPoolExecutor
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, len(self.endpoints)),
+            thread_name_prefix="psclient")
 
     def _sock(self, endpoint):
         with self._lock:
@@ -70,28 +75,25 @@ class PSClient:
                             ) -> Dict[str, Dict[str, np.ndarray]]:
         """One batched get per endpoint, endpoints in parallel (reference
         AsyncGetVar overlap, grpc_client.cc:122)."""
-        from concurrent.futures import ThreadPoolExecutor
         if len(by_ep) <= 1:
             return {ep: self._call(ep, "get_params", names=names)
                     for ep, names in by_ep.items()}
-        with ThreadPoolExecutor(max_workers=len(by_ep)) as pool:
-            futs = {ep: pool.submit(self._call, ep, "get_params", names=names)
-                    for ep, names in by_ep.items()}
-            return {ep: f.result() for ep, f in futs.items()}
+        futs = {ep: self._pool.submit(self._call, ep, "get_params",
+                                      names=names)
+                for ep, names in by_ep.items()}
+        return {ep: f.result() for ep, f in futs.items()}
 
     def push_grads_parallel(self, by_ep: Dict[str, Dict[str, np.ndarray]]):
         """One batched push per endpoint, endpoints in parallel (reference
         AsyncSendVar overlap, grpc_client.cc:66)."""
-        from concurrent.futures import ThreadPoolExecutor
         if len(by_ep) <= 1:
             for ep, grads in by_ep.items():
                 self._call(ep, "push_grads", grads=grads)
             return
-        with ThreadPoolExecutor(max_workers=len(by_ep)) as pool:
-            futs = [pool.submit(self._call, ep, "push_grads", grads=grads)
-                    for ep, grads in by_ep.items()]
-            for f in futs:
-                f.result()
+        futs = [self._pool.submit(self._call, ep, "push_grads", grads=grads)
+                for ep, grads in by_ep.items()]
+        for f in futs:
+            f.result()
 
     # -- sparse -------------------------------------------------------------
     def init_table(self, name, rows, width, dtype, init_low, init_high,
@@ -108,8 +110,13 @@ class PSClient:
     def prefetch_rows(self, name, ids: np.ndarray) -> np.ndarray:
         """Fetch rows for GLOBAL ids: split by id % n (reference
         split_ids_op), prefetch each shard, merge back in input order
-        (reference merge_ids_op)."""
+        (reference merge_ids_op). ids must be non-empty (callers skip
+        empty batches)."""
         ids = np.asarray(ids).reshape(-1)
+        if ids.size == 0:
+            raise ValueError(
+                f"prefetch_rows({name!r}): empty ids — skip the prefetch "
+                f"for empty batches instead")
         n = len(self.endpoints)
         out: Optional[np.ndarray] = None
         for i, ep in enumerate(self.endpoints):
@@ -151,6 +158,7 @@ class PSClient:
                 pass
 
     def close(self):
+        self._pool.shutdown(wait=False)
         with self._lock:
             for s in self._socks.values():
                 try:

@@ -82,5 +82,15 @@ def parse_endpoint(endpoint: str) -> Tuple[str, int]:
 def connect(endpoint: str, timeout: float = 30.0) -> socket.socket:
     host, port = parse_endpoint(endpoint)
     sock = socket.create_connection((host, port), timeout=timeout)
+    # the timeout above guards only the CONNECT; replies may legitimately
+    # take longer (barrier with skewed trainers, large gets) and a timeout
+    # mid-exchange would desynchronize the length-prefixed stream. Dead
+    # peers are detected by TCP keepalive instead of a read timeout.
+    sock.settimeout(None)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+    for opt, val in (("TCP_KEEPIDLE", 30), ("TCP_KEEPINTVL", 10),
+                     ("TCP_KEEPCNT", 6)):
+        if hasattr(socket, opt):
+            sock.setsockopt(socket.IPPROTO_TCP, getattr(socket, opt), val)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     return sock

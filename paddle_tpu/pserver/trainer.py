@@ -83,9 +83,22 @@ class AsyncPSTrainer:
     def _lr_of(self, spec) -> float:
         name = spec.get("lr_name")
         if name is None:
-            return 0.01
+            raise ValueError(
+                "optimizer op carries no LearningRate input; async PS mode "
+                "needs one")
         v = self.scope.find_var(name)
-        return float(np.asarray(v).reshape(-1)[0]) if v is not None else 0.01
+        if v is None:
+            # a missing scope var means the LR is COMPUTED in-program (a
+            # decay schedule) — silently defaulting would train at the
+            # wrong rate forever, so refuse loudly
+            raise ValueError(
+                f"learning-rate var {name!r} is not materialized in the "
+                f"scope. Async PS mode applies updates server-side with a "
+                f"constant LR captured at init_params(); in-program LR "
+                f"schedules (learning_rate_scheduler.*) are not supported "
+                f"on this path — pass a float learning_rate (reference "
+                f"async pservers share the limitation for sparse tables)")
+        return float(np.asarray(v).reshape(-1)[0])
 
     def init_params(self):
         """Every trainer offers its startup values; the server keeps the
@@ -122,6 +135,12 @@ class AsyncPSTrainer:
             flat = np.concatenate([v.reshape(-1) for v in ids_vals])
             uniq, inv = np.unique(flat, return_inverse=True)
             m = uniq.shape[0]
+            if m == 0:  # empty tail batch: feed zero tables, nothing to push
+                for wname in g["tables"]:
+                    spec = self.t.sparse_specs[wname]
+                    feed[wname] = np.zeros((spec["cap"], spec["width"]),
+                                           dtype=spec["dtype"])
+                continue
             for wname in g["tables"]:
                 spec = self.t.sparse_specs[wname]
                 if m > spec["cap"]:

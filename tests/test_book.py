@@ -1,0 +1,373 @@
+"""Book acceptance suite: the reference's end-to-end model chapters as full
+train -> save -> load -> infer cycles on the dataset modules (reference:
+python/paddle/fluid/tests/book/ — fit_a_line, recognize_digits,
+image_classification, word2vec, understand_sentiment, label_semantic_roles,
+machine_translation, recommender_system, rnn_encoder_decoder; SURVEY.md §4
+names these "the acceptance tests for any rebuild")."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.dataset import (conll05, flowers, imikolov, mnist, movielens,
+                                mq2007, sentiment, uci_housing, voc2012,
+                                wmt14)
+
+
+def _take(reader, n):
+    it = reader() if callable(reader) else reader
+    return list(itertools.islice(it, n))
+
+
+def _pad_seqs(seqs, dtype=np.int64):
+    lens = np.array([len(s) for s in seqs], np.int32)
+    T = int(lens.max())
+    out = np.zeros((len(seqs), T) + np.asarray(seqs[0][0]).shape, dtype)
+    for i, s in enumerate(seqs):
+        out[i, :len(s)] = s
+    return out, lens
+
+
+def _cycle(exe, dirname, feed_names, targets, feed, expect_shape=None):
+    """save_inference_model -> load -> infer (the book cycle tail)."""
+    fluid.io.save_inference_model(str(dirname), feed_names, targets, exe)
+    prog, f_names, fetches = fluid.io.load_inference_model(str(dirname), exe)
+    assert f_names == feed_names
+    outs = exe.run(prog, feed=feed, fetch_list=fetches)
+    for o in outs:
+        assert np.isfinite(np.asarray(o, np.float64)).all()
+    if expect_shape is not None:
+        assert tuple(np.asarray(outs[0]).shape) == tuple(expect_shape)
+    return outs
+
+
+# 1 ------------------------------------------------------------------------
+def test_book_fit_a_line(tmp_path):
+    """tests/book/test_fit_a_line.py: linear regression on uci_housing."""
+    x = layers.data(name="x", shape=[13], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    pred = layers.fc(input=x, size=1, act=None)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    data = _take(uci_housing.train(), 64)
+    xs = np.stack([d[0] for d in data])
+    ys = np.stack([d[1] for d in data])
+    losses = [float(np.asarray(exe.run(feed={"x": xs, "y": ys},
+                                       fetch_list=[loss])[0]).reshape(-1)[0])
+              for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.5, losses
+
+    _cycle(exe, tmp_path, ["x"], [pred], {"x": xs[:4]}, expect_shape=(4, 1))
+
+
+# 2 ------------------------------------------------------------------------
+def test_book_recognize_digits(tmp_path):
+    """tests/book/test_recognize_digits.py: LeNet-ish conv on mnist."""
+    img = layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    conv_pool = fluid.nets.simple_img_conv_pool(
+        input=img, filter_size=5, num_filters=8, pool_size=2, pool_stride=2,
+        act="relu")
+    logits = layers.fc(input=conv_pool, size=10, act=None)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(layers.softmax(logits), label)
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    data = _take(mnist.train(), 128)
+    xs = np.stack([d[0] for d in data]).reshape(-1, 1, 28, 28)
+    ys = np.array([d[1] for d in data], np.int64).reshape(-1, 1)
+    accs = []
+    for _ in range(25):
+        _, a = exe.run(feed={"img": xs, "label": ys}, fetch_list=[loss, acc])
+        accs.append(float(np.asarray(a).reshape(-1)[0]))
+    assert accs[-1] > 0.7, accs
+
+    sm = layers.softmax(logits)
+    _cycle(exe, tmp_path, ["img"], [sm], {"img": xs[:4]},
+           expect_shape=(4, 10))
+
+
+# 3 ------------------------------------------------------------------------
+def test_book_image_classification(tmp_path):
+    """tests/book/test_image_classification.py: conv group on flowers-like
+    images (cifar resolution kept small for CI)."""
+    img = layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    conv = fluid.nets.img_conv_group(
+        input=img, conv_num_filter=[8, 8], conv_filter_size=3,
+        conv_act="relu", conv_with_batchnorm=True, pool_size=2,
+        pool_stride=2)
+    logits = layers.fc(input=conv, size=8, act=None)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(0)
+    ys = rng.randint(0, 8, (32, 1)).astype(np.int64)
+    xs = (rng.rand(32, 3, 32, 32).astype(np.float32) * 0.1
+          + ys.reshape(-1, 1, 1, 1) / 8.0)
+    losses = [float(np.asarray(exe.run(feed={"img": xs, "label": ys},
+                                       fetch_list=[loss])[0]).reshape(-1)[0])
+              for _ in range(15)]
+    assert losses[-1] < losses[0], losses
+    _cycle(exe, tmp_path, ["img"], [layers.softmax(logits)],
+           {"img": xs[:2]}, expect_shape=(2, 8))
+
+
+# 4 ------------------------------------------------------------------------
+def test_book_word2vec(tmp_path):
+    """tests/book/test_word2vec.py: N-gram LM on imikolov."""
+    N, EMB, DICT = 4, 16, 100
+    words = [layers.data(name=f"w{i}", shape=[1], dtype="int64")
+             for i in range(N)]
+    label = layers.data(name="next", shape=[1], dtype="int64")
+    embs = [layers.embedding(w, size=[DICT, EMB],
+                             param_attr=fluid.ParamAttr(name="shared_emb"))
+            for w in words]
+    concat = layers.concat([layers.reshape(e, shape=[-1, EMB])
+                            for e in embs], axis=1)
+    hidden = layers.fc(input=concat, size=64, act="sigmoid")
+    logits = layers.fc(input=hidden, size=DICT, act=None)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    word_idx = imikolov.build_dict()
+    data = _take(imikolov.train(word_idx, N + 1), 256)
+    arr = np.array(data, np.int64) % DICT
+    feed = {f"w{i}": arr[:, i:i + 1] for i in range(N)}
+    feed["next"] = arr[:, N:N + 1]
+    losses = [float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0])
+                    .reshape(-1)[0]) for _ in range(20)]
+    assert losses[-1] < losses[0], losses
+    infer_feed = {f"w{i}": arr[:3, i:i + 1] for i in range(N)}
+    _cycle(exe, tmp_path, [f"w{i}" for i in range(N)],
+           [layers.softmax(logits)], infer_feed, expect_shape=(3, DICT))
+
+
+# 5 ------------------------------------------------------------------------
+def test_book_understand_sentiment(tmp_path):
+    """tests/book/test_understand_sentiment.py: text conv classifier on the
+    sentiment dataset."""
+    DICT, EMB = 300, 16
+    words = layers.data(name="words", shape=[1], dtype="int64", lod_level=1)
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    emb = layers.embedding(words, size=[DICT, EMB])
+    conv = fluid.nets.sequence_conv_pool(input=emb, num_filters=16,
+                                         filter_size=3, act="tanh",
+                                         pool_type="max")
+    logits = layers.fc(input=conv, size=2, act=None)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(layers.softmax(logits), label)
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    data = _take(sentiment.train(), 64)
+    seqs = [np.array(d[0], np.int64).reshape(-1, 1) for d in data]
+    ys = np.array([d[1] for d in data], np.int64).reshape(-1, 1)
+    padded, lens = _pad_seqs(seqs)
+    accs = []
+    for _ in range(25):
+        _, a = exe.run(feed={"words": (padded, lens), "label": ys},
+                       fetch_list=[loss, acc])
+        accs.append(float(np.asarray(a).reshape(-1)[0]))
+    assert accs[-1] > 0.8, accs
+    _cycle(exe, tmp_path, ["words"], [layers.softmax(logits)],
+           {"words": (padded[:4], lens[:4])}, expect_shape=(4, 2))
+
+
+# 6 ------------------------------------------------------------------------
+def test_book_label_semantic_roles(tmp_path):
+    """tests/book/test_label_semantic_roles.py: SRL with 8 feature inputs,
+    shared embeddings, bidirectional dynamic LSTM and a CRF objective."""
+    word_dict, verb_dict, label_dict = conll05.get_dict()
+    WORD, PRED, LABEL, EMB, H = (len(word_dict), len(verb_dict),
+                                 len(label_dict), 16, 32)
+    feats = ["word", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1", "ctx_p2"]
+    ins = {n: layers.data(name=n, shape=[1], dtype="int64", lod_level=1)
+           for n in feats + ["pred", "mark"]}
+    target = layers.data(name="target", shape=[1], dtype="int64",
+                         lod_level=1)
+    embs = [layers.embedding(ins[n], size=[WORD, EMB],
+                             param_attr=fluid.ParamAttr(name="w_emb"))
+            for n in feats]
+    embs.append(layers.embedding(ins["pred"], size=[PRED, EMB]))
+    embs.append(layers.embedding(ins["mark"], size=[2, EMB]))
+    feat = layers.concat(embs, axis=2)
+    proj = layers.fc(input=layers.reshape(feat, shape=[0, -1, 8 * EMB]),
+                     size=4 * H, num_flatten_dims=2)
+    lstm, _cell = layers.dynamic_lstm(proj, size=4 * H)
+    emission = layers.fc(input=lstm, size=LABEL, num_flatten_dims=2)
+    crf_cost = layers.linear_chain_crf(
+        emission, target, param_attr=fluid.ParamAttr(name="crfw"))
+    loss = layers.mean(crf_cost)
+    fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    data = _take(conll05.test(), 32)
+    names = feats + ["pred", "mark", "target"]
+    losses = []
+    seq_cols = [[np.array(d[i], np.int64).reshape(-1, 1) for d in data]
+                for i in range(9)]
+    feed = {}
+    for n, col in zip(names, seq_cols):
+        padded, lens = _pad_seqs(col)
+        feed[n] = (padded, lens)
+    for _ in range(15):
+        l, = exe.run(feed=feed, fetch_list=[loss])
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    assert losses[-1] < losses[0], losses
+
+    path = layers.crf_decoding(emission,
+                               param_attr=fluid.ParamAttr(name="crfw"))
+    infer_feed = {n: feed[n] for n in feats + ["pred", "mark"]}
+    outs = _cycle(exe, tmp_path, feats + ["pred", "mark"], [path],
+                  infer_feed)
+    assert np.asarray(outs[0]).ndim >= 2
+
+
+# 7 ------------------------------------------------------------------------
+def test_book_machine_translation(tmp_path):
+    """tests/book/test_machine_translation.py: attention seq2seq on wmt14
+    (synthetic permutation corpus)."""
+    from paddle_tpu.models import machine_translation as mt
+    DICT = 30
+    feeds, outs = mt.build(dict_size=DICT, emb_dim=16, hidden_dim=16)
+    loss = outs["loss"]
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    data = _take(wmt14.train(DICT), 32)
+    src, src_l = _pad_seqs([np.array(d[0], np.int64).reshape(-1, 1)
+                            for d in data])
+    trg, trg_l = _pad_seqs([np.array(d[1], np.int64).reshape(-1, 1)
+                            for d in data])
+    nxt, _ = _pad_seqs([np.array(d[2], np.int64).reshape(-1, 1)
+                        for d in data])
+    losses = []
+    for _ in range(12):
+        l, = exe.run(feed={"src_word": (src, src_l),
+                           "trg_word": trg,
+                           "lbl_word": nxt}, fetch_list=[loss])
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    assert losses[-1] < losses[0], losses
+
+
+# 8 ------------------------------------------------------------------------
+def test_book_recommender_system(tmp_path):
+    """tests/book/test_recommender_system.py: user/movie towers + cos_sim
+    on movielens, scaled square error on the rating."""
+    data = _take(movielens.train(), 64)
+    user = np.array([d[0] for d in data], np.int64).reshape(-1, 1)
+    gender = np.array([d[1] for d in data], np.int64).reshape(-1, 1)
+    age = np.array([d[2] for d in data], np.int64).reshape(-1, 1)
+    job = np.array([d[3] for d in data], np.int64).reshape(-1, 1)
+    movie = np.array([d[4] for d in data], np.int64).reshape(-1, 1)
+    rating = np.array([d[7] for d in data], np.float32).reshape(-1, 1)
+    U, M = int(user.max()) + 1, int(movie.max()) + 1
+
+    uid = layers.data(name="uid", shape=[1], dtype="int64")
+    ugender = layers.data(name="ugender", shape=[1], dtype="int64")
+    uage = layers.data(name="uage", shape=[1], dtype="int64")
+    ujob = layers.data(name="ujob", shape=[1], dtype="int64")
+    mid = layers.data(name="mid", shape=[1], dtype="int64")
+    score = layers.data(name="score", shape=[1], dtype="float32")
+
+    def tower(parts, size=32):
+        cat = layers.concat(parts, axis=1)
+        return layers.fc(input=cat, size=size, act="tanh")
+
+    def emb2d(x, n, d=16):
+        return layers.reshape(layers.embedding(x, size=[n, d]),
+                              shape=[-1, d])
+
+    usr = tower([emb2d(uid, U), emb2d(ugender, 2), emb2d(uage, 60),
+                 emb2d(ujob, 25)])
+    mov = tower([emb2d(mid, M)])
+    sim = layers.cos_sim(usr, mov)
+    pred = layers.scale(sim, scale=5.0)
+    loss = layers.mean(layers.square_error_cost(pred, score))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    feed = {"uid": user, "ugender": gender, "uage": age, "ujob": job,
+            "mid": movie, "score": rating}
+    losses = [float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0])
+                    .reshape(-1)[0]) for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.8, losses
+    _cycle(exe, tmp_path, ["uid", "ugender", "uage", "ujob", "mid"],
+           [pred], {k: v[:4] for k, v in feed.items() if k != "score"},
+           expect_shape=(4, 1))
+
+
+# 9 ------------------------------------------------------------------------
+def test_book_rnn_encoder_decoder(tmp_path):
+    """tests/book/test_rnn_encoder_decoder.py: GRU encoder + GRU decoder
+    (no attention) via StaticRNN over wmt14."""
+    DICT, EMB, H = 30, 16, 16
+    src = layers.data(name="src", shape=[1], dtype="int64", lod_level=1)
+    trg = layers.data(name="trg", shape=[1], dtype="int64", lod_level=1)
+    nxt = layers.data(name="nxt", shape=[1], dtype="int64", lod_level=1)
+
+    src_emb = layers.embedding(src, size=[DICT, EMB])
+    enc_proj = layers.fc(input=src_emb, size=3 * H, num_flatten_dims=2)
+    enc = layers.dynamic_gru(enc_proj, size=H)
+    enc_last = layers.sequence_pool(enc, pool_type="last")
+
+    trg_emb = layers.embedding(trg, size=[DICT, EMB])
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        w = rnn.step_input(trg_emb)
+        h = rnn.memory(init=enc_last)
+        nh = layers.fc(input=layers.concat([w, h], axis=1), size=H,
+                       act="tanh")
+        rnn.update_memory(h, nh)
+        rnn.step_output(nh)
+    dec = rnn()
+    logits = layers.fc(input=dec, size=DICT, num_flatten_dims=2)
+    loss = layers.mean(layers.softmax_with_cross_entropy(
+        logits, nxt, ignore_index=0))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    data = _take(wmt14.train(DICT), 16)
+    s, sl = _pad_seqs([np.array(d[0], np.int64).reshape(-1, 1)
+                       for d in data])
+    t, tl = _pad_seqs([np.array(d[1], np.int64).reshape(-1, 1)
+                       for d in data])
+    n, _ = _pad_seqs([np.array(d[2], np.int64).reshape(-1, 1)
+                      for d in data])
+    losses = []
+    for _ in range(12):
+        l, = exe.run(feed={"src": (s, sl), "trg": (t, tl), "nxt": (n, tl)},
+                     fetch_list=[loss])
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    assert losses[-1] < losses[0], losses
+
+
+# bonus: the remaining dataset modules are importable and yield the
+# documented schemas ---------------------------------------------------------
+def test_new_dataset_schemas():
+    img, mask = next(voc2012.train()())
+    assert img.shape == (3, 32, 32) and mask.shape == (32, 32)
+    img, label = next(flowers.train()())
+    assert img.shape == (3, 224, 224) and 0 <= label < 102
+    lbl, left, right = next(mq2007.train("pairwise")())
+    assert left.shape == (46,) and lbl.shape == (1,)
+    rel, feats = next(mq2007.train("listwise")())
+    assert feats.shape[1] == 46 and rel.shape == (feats.shape[0], 1)
