@@ -16,6 +16,8 @@ from .control_flow import (While, StaticRNN, Switch, DynamicRNN,  # noqa: F401
                            lod_tensor_to_array, array_to_lod_tensor,
                            shrink_memory, reorder_lod_tensor_by_rank)
 from . import learning_rate_scheduler  # noqa: F401
+from . import detection  # noqa: F401
+from .quant import fake_quantize, fake_dequantize  # noqa: F401
 from .math_op_patch import monkey_patch_variable
 
 monkey_patch_variable()

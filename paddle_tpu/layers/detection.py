@@ -1,0 +1,249 @@
+"""Detection layers DSL (reference: python/paddle/fluid/layers/detection.py
+— prior_box :likely, multi_box_head, bipartite_match, target_assign,
+ssd_loss, detection_output, box_coder, iou_similarity, anchor_generator,
+polygon_box_transform). Op lowerings in ops/detection.py document the
+TPU-native static-shape redesign (masks/counts instead of LoD outputs)."""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+from . import nn as _nn
+from . import tensor as _t
+
+
+def _op(helper, type, inputs, out_slots, attrs=None, dtypes=None):
+    outs = {}
+    vars_ = []
+    for i, slot in enumerate(out_slots):
+        dt = (dtypes or {}).get(slot, "float32")
+        v = helper.create_variable_for_type_inference(dtype=dt)
+        outs[slot] = [v.name]
+        vars_.append(v)
+    helper.append_op(type, inputs=inputs, outputs=outs, attrs=attrs or {})
+    return vars_
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    helper = LayerHelper("prior_box", name=name)
+    boxes, var = _op(helper, "prior_box",
+                     {"Input": [input.name], "Image": [image.name]},
+                     ("Boxes", "Variances"),
+                     {"min_sizes": list(min_sizes),
+                      "max_sizes": list(max_sizes or []),
+                      "aspect_ratios": list(aspect_ratios),
+                      "variances": list(variance), "flip": flip,
+                      "clip": clip, "step_w": steps[0], "step_h": steps[1],
+                      "offset": offset,
+                      "min_max_aspect_ratios_order":
+                          min_max_aspect_ratios_order})
+    return boxes, var
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None, offset=0.5,
+                     name=None):
+    helper = LayerHelper("anchor_generator", name=name)
+    anchors, var = _op(helper, "anchor_generator", {"Input": [input.name]},
+                       ("Anchors", "Variances"),
+                       {"anchor_sizes": list(anchor_sizes or [64, 128, 256]),
+                        "aspect_ratios": list(aspect_ratios or [0.5, 1, 2]),
+                        "variances": list(variance),
+                        "stride": list(stride or [16.0, 16.0]),
+                        "offset": offset})
+    return anchors, var
+
+
+def iou_similarity(x, y, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    out, = _op(helper, "iou_similarity", {"X": [x.name], "Y": [y.name]},
+               ("Out",))
+    return out
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None):
+    helper = LayerHelper("box_coder", name=name)
+    inputs = {"PriorBox": [prior_box.name], "TargetBox": [target_box.name]}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var.name]
+    out, = _op(helper, "box_coder", inputs, ("OutputBox",),
+               {"code_type": code_type, "box_normalized": box_normalized})
+    return out
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5, name=None):
+    helper = LayerHelper("bipartite_match", name=name)
+    idx, dist = _op(helper, "bipartite_match",
+                    {"DistMat": [dist_matrix.name]},
+                    ("ColToRowMatchIndices", "ColToRowMatchDist"),
+                    {"match_type": match_type,
+                     "dist_threshold": dist_threshold},
+                    dtypes={"ColToRowMatchIndices": "int32"})
+    return idx, dist
+
+
+def target_assign(input, matched_indices, negative_mask=None,
+                  mismatch_value=0.0, name=None):
+    helper = LayerHelper("target_assign", name=name)
+    inputs = {"X": [input.name], "MatchIndices": [matched_indices.name]}
+    if negative_mask is not None:
+        inputs["NegMask"] = [negative_mask.name]
+    out, weight = _op(helper, "target_assign", inputs,
+                      ("Out", "OutWeight"),
+                      {"mismatch_value": float(mismatch_value)})
+    return out, weight
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.01, nms_top_k=400,
+                   keep_top_k=200, nms_threshold=0.3, background_label=0,
+                   nms_eta=1.0, normalized=True, name=None):
+    """Static-shape NMS: Out [B, keep_top_k, 6] padded with label=-1 plus
+    Count [B] (reference emits LoD; see ops/detection.py)."""
+    helper = LayerHelper("multiclass_nms", name=name)
+    out, count = _op(helper, "multiclass_nms",
+                     {"BBoxes": [bboxes.name], "Scores": [scores.name]},
+                     ("Out", "Count"),
+                     {"score_threshold": score_threshold,
+                      "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+                      "nms_threshold": nms_threshold,
+                      "background_label": background_label,
+                      "nms_eta": nms_eta, "normalized": normalized},
+                     dtypes={"Count": "int32"})
+    return out, count
+
+
+detection_output = multiclass_nms  # reference detection_output wraps
+# box_coder decode + multiclass_nms; compose explicitly when deltas are fed
+
+
+def polygon_box_transform(input, name=None):
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out, = _op(helper, "polygon_box_transform", {"Input": [input.name]},
+               ("Output",))
+    return out
+
+
+def mine_hard_examples(cls_loss, match_indices, loc_loss=None,
+                       match_dist=None, neg_pos_ratio=3.0,
+                       neg_dist_threshold=0.5, name=None):
+    helper = LayerHelper("mine_hard_examples", name=name)
+    inputs = {"ClsLoss": [cls_loss.name],
+              "MatchIndices": [match_indices.name]}
+    if loc_loss is not None:
+        inputs["LocLoss"] = [loc_loss.name]
+    if match_dist is not None:
+        inputs["MatchDist"] = [match_dist.name]
+    neg, upd = _op(helper, "mine_hard_examples", inputs,
+                   ("NegMask", "UpdatedMatchIndices"),
+                   {"neg_pos_ratio": neg_pos_ratio,
+                    "neg_dist_threshold": neg_dist_threshold},
+                   dtypes={"NegMask": "int32",
+                           "UpdatedMatchIndices": "int32"})
+    return neg, upd
+
+
+def rpn_target_assign(anchor_box, gt_box, dist_matrix,
+                      rpn_batch_size_per_im=256, rpn_fg_fraction=0.5,
+                      rpn_positive_overlap=0.7, rpn_negative_overlap=0.3,
+                      name=None):
+    helper = LayerHelper("rpn_target_assign", name=name)
+    labels, match = _op(helper, "rpn_target_assign",
+                        {"Anchor": [anchor_box.name],
+                         "GtBox": [gt_box.name],
+                         "DistMat": [dist_matrix.name]},
+                        ("Labels", "MatchIndices"),
+                        {"rpn_batch_size_per_im": rpn_batch_size_per_im,
+                         "rpn_fg_fraction": rpn_fg_fraction,
+                         "rpn_positive_overlap": rpn_positive_overlap,
+                         "rpn_negative_overlap": rpn_negative_overlap},
+                        dtypes={"Labels": "int32", "MatchIndices": "int32"})
+    return labels, match
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, loc_loss_weight=1.0, conf_loss_weight=1.0,
+             mismatch_value=0.0, name=None):
+    """SSD multibox loss (reference detection.py ssd_loss): match priors to
+    gt (bipartite + per_prediction), mine hard negatives, localization
+    smooth-L1 on matched priors + confidence cross-entropy on matched and
+    mined-negative priors. gt_box [B, N, 4], gt_label [B, N, 1] (padded
+    rows get label 0 = background), location [B, M, 4] deltas,
+    confidence [B, M, C], prior_box [M, 4]."""
+    from . import ops as lops
+
+    helper = LayerHelper("ssd_loss", name=name)
+    iou = iou_similarity(gt_box, prior_box)               # [B, N, M]
+    match_idx, match_dist = bipartite_match(
+        iou, match_type="per_prediction",
+        dist_threshold=overlap_threshold)                 # [B, M]
+
+    # encode gt boxes onto priors per image, gathered by the match
+    gt_on_prior, loc_weight = target_assign(
+        gt_box, match_idx, mismatch_value=mismatch_value)  # [B, M, 4]
+    enc_gt = _encode_per_prior(helper, gt_on_prior, prior_box,
+                               prior_box_var)
+
+    loc_diff = lops.elementwise_sub(location, enc_gt)
+    loc_l = _smooth_l1(loc_diff)
+    loc_l = lops.elementwise_mul(
+        _nn.reduce_sum(loc_l, dim=[2]), _squeeze_w(loc_weight))
+
+    # confidence loss: softmax CE against assigned labels
+    lbl_on_prior, _ = target_assign(gt_label, match_idx,
+                                    mismatch_value=background_label)
+    conf_l = _softmax_ce_per_prior(confidence, lbl_on_prior)   # [B, M]
+    neg_mask, _ = mine_hard_examples(conf_l, match_idx,
+                                     match_dist=match_dist,
+                                     neg_pos_ratio=neg_pos_ratio,
+                                     neg_dist_threshold=overlap_threshold)
+    pos = _match_mask(helper, match_idx)
+    keep = lops.elementwise_add(pos, _t.cast(neg_mask, "float32"))
+    conf_l = lops.elementwise_mul(conf_l, keep)
+
+    total = lops.elementwise_add(
+        _nn.scale(loc_l, scale=loc_loss_weight),
+        _nn.scale(conf_l, scale=conf_loss_weight))
+    return total
+
+
+# --- small graph helpers used by ssd_loss ---------------------------------
+
+def _encode_per_prior(helper, gt_on_prior, prior_box, prior_box_var):
+    out, = _op(helper, "box_encode_per_prior",
+               {"TargetBox": [gt_on_prior.name],
+                "PriorBox": [prior_box.name]}
+               | ({"PriorBoxVar": [prior_box_var.name]}
+                  if prior_box_var is not None else {}),
+               ("OutputBox",))
+    return out
+
+
+def _squeeze_w(w):
+    return _nn.reduce_sum(w, dim=[2])
+
+
+def _match_mask(helper, match_idx):
+    ge = _op(helper, "greater_equal_scalar0",
+             {"X": [match_idx.name]}, ("Out",), dtypes={"Out": "float32"})
+    return ge[0]
+
+
+def _smooth_l1(absdiff):
+    helper = LayerHelper("smooth_l1_elem")
+    out, = _op(helper, "smooth_l1_elementwise", {"X": [absdiff.name]},
+               ("Out",))
+    return out
+
+
+def _softmax_ce_per_prior(confidence, labels):
+    helper = LayerHelper("conf_ce")
+    out, = _op(helper, "softmax_ce_no_reduce",
+               {"Logits": [confidence.name], "Label": [labels.name]},
+               ("Out",))
+    return out

@@ -9,6 +9,8 @@ from . import sequence  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import control  # noqa: F401
 from . import tensor_array  # noqa: F401
+from . import detection  # noqa: F401
+from . import quantize  # noqa: F401
 from . import beam  # noqa: F401
 from . import loss_extra  # noqa: F401
 from . import pallas_attention  # noqa: F401

