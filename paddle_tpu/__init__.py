@@ -13,7 +13,7 @@ from .core import ir as _ir
 from .core.ir import (Program, program_guard, default_main_program,  # noqa: F401
                       default_startup_program, Variable, Parameter)
 from .core.executor import (Executor, Scope, global_scope,  # noqa: F401
-                            CPUPlace, TPUPlace, CUDAPlace)
+                            CPUPlace, TPUPlace, CUDAPlace, EOFException)
 from .core.backward import append_backward, calc_gradient  # noqa: F401
 
 from . import ops  # noqa: F401  (registers all lowering rules)
@@ -40,6 +40,8 @@ from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa
 from .transpiler import memory_optimize, release_memory, InferenceTranspiler  # noqa: F401
 from . import distributed  # noqa: F401
 from . import pserver  # noqa: F401
+from . import master  # noqa: F401
+from . import recordio  # noqa: F401
 from .trainer import (Trainer, Inferencer, CheckpointConfig,  # noqa: F401
                       BeginEpochEvent, EndEpochEvent, BeginStepEvent,
                       EndStepEvent, save_checkpoint, load_checkpoint)

@@ -30,6 +30,12 @@ from .lowering import BlockLowerer
 logger = logging.getLogger(__name__)
 
 
+class EOFException(Exception):
+    """Raised when a py_reader-fed program drains its queue (reference:
+    paddle/fluid/framework/reader.h EOF semantics surfaced as
+    core.EOFException in python)."""
+
+
 # ---------------------------------------------------------------------------
 # Places (reference: platform/place.h). On TPU these are thin shims over jax
 # devices; XLA/PJRT owns device memory and streams.
@@ -241,6 +247,11 @@ class Executor:
                                  trainers=ls[0].attrs.get("trainers", 1))
             ps.serve_forever()
             return []
+
+        # py_reader-fed program: no feed -> pop the next queued batch
+        # (raises EOFException at end of pass, reference read-op contract)
+        if not feed and getattr(program, "_py_reader", None) is not None:
+            feed = program._py_reader.next_feed()
         fetch_names = [f.name if isinstance(f, ir.Variable) else str(f)
                        for f in fetch_list]
 

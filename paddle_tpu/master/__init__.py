@@ -1,0 +1,6 @@
+"""Elastic data-sharding master (reference: go/master/ — task queue with
+lease timeouts, failure budgets, and snapshot/recover; the P9 elastic
+training capability)."""
+
+from .service import Master  # noqa: F401
+from .client import MasterClient  # noqa: F401

@@ -1,0 +1,108 @@
+"""Master client: trainers pull task leases and stream records.
+
+Capability parity with the reference Go client (reference:
+go/master/client.go — GetTask/TaskFinished RPC, NextRecord :244 which
+streams records out of the leased chunks; python ctypes wrapper
+python/paddle/v2/master/client.py:29)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Iterable, Optional
+
+from ..pserver import rpc
+
+
+class MasterClient:
+    def __init__(self, endpoint: str, retry_interval: float = 0.5):
+        self.endpoint = endpoint
+        self.retry_interval = retry_interval
+        self._sock = None
+        self._lock = threading.Lock()
+
+    def _call(self, cmd, **payload):
+        with self._lock:
+            try:
+                if self._sock is None:
+                    self._sock = rpc.connect(self.endpoint)
+                rpc.send_msg(self._sock, (cmd, payload))
+                status, value = rpc.recv_msg(self._sock)
+            except (ConnectionError, EOFError, OSError):
+                # drop the dead socket so the NEXT call reconnects — a
+                # master restarted from its snapshot must be reachable
+                # again without restarting the trainer (elastic contract)
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                raise
+        if status != "ok":
+            raise RuntimeError(f"master {self.endpoint} {cmd}: {value}")
+        return value
+
+    def set_dataset(self, payloads, chunks_per_task=1):
+        return self._call("set_dataset", payloads=list(payloads),
+                          chunks_per_task=chunks_per_task)
+
+    def get_task(self):
+        """Returns (status, task) where status is 'ok' | 'none' |
+        'no_more'."""
+        return self._call("get_task")
+
+    def task_finished(self, task_id, epoch):
+        return self._call("task_finished", task_id=task_id, epoch=epoch)
+
+    def task_failed(self, task_id, epoch):
+        return self._call("task_failed", task_id=task_id, epoch=epoch)
+
+    def start_new_pass(self):
+        return self._call("start_new_pass")
+
+    def stats(self):
+        return self._call("stats")
+
+    def stop_master(self):
+        try:
+            self._call("stop")
+        except (RuntimeError, ConnectionError, OSError):
+            pass
+
+    def close(self):
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+    # -- record streaming (reference NextRecord :244) ----------------------
+    def records(self, load_chunk: Callable[[Any], Iterable],
+                stop_when_drained: bool = True):
+        """Generator over records of leased tasks: pulls a task, yields
+        every record `load_chunk(payload_item)` produces, then marks the
+        task finished — a trainer crash mid-task leaves the lease to
+        expire and the task is re-issued elsewhere (the elastic property)."""
+        while True:
+            status, task = self.get_task()
+            if status == "no_more":
+                if stop_when_drained:
+                    return
+                time.sleep(self.retry_interval)
+                continue
+            if status == "none":
+                time.sleep(self.retry_interval)
+                continue
+            try:
+                for item in task["payload"]:
+                    for rec in load_chunk(item):
+                        yield rec
+            except GeneratorExit:
+                raise
+            except Exception:
+                self.task_failed(task["task_id"], task["epoch"])
+                raise
+            self.task_finished(task["task_id"], task["epoch"])

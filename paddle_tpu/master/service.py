@@ -1,0 +1,255 @@
+"""Elastic data-sharding master (P9).
+
+Capability parity with the reference Go master (reference:
+go/master/service.go — partition :106, SetDataset :280, GetTask :368,
+TaskFinished :411, TaskFailed :455, timeout re-queue via checkTimeoutFunc
+:341, processFailedTask :313 with failureMax, etcd snapshot :207 /
+recover :166).
+
+TPU-native redesign: etcd is replaced by an on-disk JSON snapshot (the
+cluster filesystem is the coordination substrate available here), and the
+Go RPC by the same length-prefixed-pickle transport as the parameter
+server (pserver/rpc.py). Task semantics are identical: a task is a lease
+with an epoch counter — a trainer that dies mid-task simply lets the lease
+time out and the task is re-issued; a task failing more than `failure_max`
+times is discarded with a log line (reference :323-331)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..pserver import rpc
+
+logger = logging.getLogger(__name__)
+
+
+class _Task:
+    __slots__ = ("task_id", "payload", "epoch", "num_failure", "deadline")
+
+    def __init__(self, task_id, payload, epoch=0, num_failure=0):
+        self.task_id = task_id
+        self.payload = payload
+        self.epoch = epoch          # bumped on every (re-)issue; stale
+        self.num_failure = num_failure
+        self.deadline = 0.0         # lease expiry while pending
+
+    def to_dict(self):
+        return {"task_id": self.task_id, "payload": self.payload,
+                "epoch": self.epoch, "num_failure": self.num_failure}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(d["task_id"], d["payload"], d["epoch"], d["num_failure"])
+
+
+class Master:
+    """Task-queue service. `timeout_dur` is the lease duration
+    (reference timeoutDur); `failure_max` the per-task failure budget."""
+
+    def __init__(self, endpoint: str, snapshot_path: Optional[str] = None,
+                 timeout_dur: float = 20.0, failure_max: int = 3,
+                 check_interval: float = 1.0):
+        self.endpoint = endpoint
+        self.snapshot_path = snapshot_path
+        self.timeout_dur = timeout_dur
+        self.failure_max = failure_max
+        self.check_interval = check_interval
+        self._todo: List[_Task] = []
+        self._pending: Dict[int, _Task] = {}
+        self._done: List[_Task] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._listener: Optional[socket.socket] = None
+        self._epoch_pass = 0
+        if snapshot_path and os.path.exists(snapshot_path):
+            self._recover()
+
+    # -- dataset ----------------------------------------------------------
+    def set_dataset(self, payloads: List[Any], chunks_per_task: int = 1):
+        """Partition payloads into tasks (reference partition :106).
+        Idempotent across restarts: only applies when the queue is empty
+        and nothing was recovered (reference SetDataset :280 ignores
+        re-registration once initialized)."""
+        with self._lock:
+            if self._todo or self._pending or self._done:
+                return
+            tid = 0
+            for i in range(0, len(payloads), chunks_per_task):
+                self._todo.append(_Task(tid, payloads[i:i + chunks_per_task]))
+                tid += 1
+            self._snapshot_locked()
+
+    # -- task lifecycle ---------------------------------------------------
+    def get_task(self):
+        with self._lock:
+            if not self._todo:
+                if not self._pending and self._done:
+                    return ("no_more", None)       # pass finished
+                return ("none", None)              # wait: leases pending
+            t = self._todo.pop(0)
+            t.epoch += 1
+            t.deadline = time.time() + self.timeout_dur
+            self._pending[t.task_id] = t
+            self._snapshot_locked()
+            return ("ok", {"task_id": t.task_id, "epoch": t.epoch,
+                           "payload": t.payload})
+
+    def task_finished(self, task_id: int, epoch: int):
+        with self._lock:
+            t = self._pending.get(task_id)
+            if t is None or t.epoch != epoch:
+                return False                       # stale lease (re-issued)
+            del self._pending[task_id]
+            self._done.append(t)
+            if not self._todo and not self._pending:
+                logger.info("master: pass %d complete (%d tasks)",
+                            self._epoch_pass, len(self._done))
+            self._snapshot_locked()
+            return True
+
+    def task_failed(self, task_id: int, epoch: int):
+        with self._lock:
+            t = self._pending.get(task_id)
+            if t is None or t.epoch != epoch:
+                return False
+            del self._pending[task_id]
+            self._process_failed_locked(t)
+            self._snapshot_locked()
+            return True
+
+    def _process_failed_locked(self, t: _Task):
+        """reference processFailedTask :313: discard past failure_max."""
+        t.num_failure += 1
+        if t.num_failure > self.failure_max:
+            logger.warning("master: task %d failed %d times, discarding",
+                           t.task_id, t.num_failure)
+            self._done.append(t)
+            return
+        self._todo.append(t)
+
+    def start_new_pass(self):
+        """Re-queue everything for another data pass."""
+        with self._lock:
+            self._todo.extend(self._done)
+            self._done = []
+            for t in self._todo:
+                t.num_failure = 0
+            self._epoch_pass += 1
+            self._snapshot_locked()
+
+    def _check_timeouts(self):
+        while not self._stop.wait(self.check_interval):
+            now = time.time()
+            with self._lock:
+                expired = [t for t in self._pending.values()
+                           if t.deadline < now]
+                for t in expired:
+                    logger.info("master: task %d lease expired, re-queueing",
+                                t.task_id)
+                    del self._pending[t.task_id]
+                    self._process_failed_locked(t)
+                if expired:
+                    self._snapshot_locked()
+
+    # -- persistence (etcd analog) ----------------------------------------
+    def _snapshot_locked(self):
+        if not self.snapshot_path:
+            return
+        state = {"todo": [t.to_dict() for t in self._todo],
+                 "pending": [t.to_dict() for t in self._pending.values()],
+                 "done": [t.to_dict() for t in self._done],
+                 "pass": self._epoch_pass}
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self.snapshot_path)
+
+    def _recover(self):
+        """reference recover :166: pending tasks go back to todo — their
+        leases died with the previous master process."""
+        with open(self.snapshot_path) as f:
+            state = json.load(f)
+        self._todo = [_Task.from_dict(d)
+                      for d in state["todo"] + state["pending"]]
+        self._done = [_Task.from_dict(d) for d in state["done"]]
+        self._epoch_pass = state.get("pass", 0)
+        logger.info("master: recovered %d todo / %d done from %s",
+                    len(self._todo), len(self._done), self.snapshot_path)
+
+    # -- service loop (same wire protocol as the pserver) ------------------
+    def start(self) -> "Master":
+        host, port = rpc.parse_endpoint(self.endpoint)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        if port == 0:
+            self.endpoint = f"{host}:{self._listener.getsockname()[1]}"
+        self._listener.listen(64)
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name=f"master@{self.endpoint}").start()
+        threading.Thread(target=self._check_timeouts, daemon=True,
+                         name="master-timeouts").start()
+        return self
+
+    def serve_forever(self):
+        self.start()
+        self._stop.wait()
+
+    def stop(self):
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn):
+        try:
+            while not self._stop.is_set():
+                try:
+                    cmd, p = rpc.recv_msg(conn)
+                except (ConnectionError, EOFError, OSError):
+                    return
+                try:
+                    if cmd == "get_task":
+                        reply = ("ok", self.get_task())
+                    elif cmd == "task_finished":
+                        reply = ("ok", self.task_finished(**p))
+                    elif cmd == "task_failed":
+                        reply = ("ok", self.task_failed(**p))
+                    elif cmd == "set_dataset":
+                        reply = ("ok", self.set_dataset(**p))
+                    elif cmd == "start_new_pass":
+                        reply = ("ok", self.start_new_pass())
+                    elif cmd == "stats":
+                        with self._lock:
+                            reply = ("ok", {"todo": len(self._todo),
+                                            "pending": len(self._pending),
+                                            "done": len(self._done)})
+                    elif cmd == "stop":
+                        reply = ("ok", None)
+                    else:
+                        reply = ("err", f"unknown command {cmd!r}")
+                except Exception as e:
+                    reply = ("err", f"{type(e).__name__}: {e}")
+                rpc.send_msg(conn, reply)
+                if cmd == "stop":
+                    self.stop()
+                    return
+        finally:
+            conn.close()

@@ -2,3 +2,4 @@
 
 from .decorator import (map_readers, shuffle, chain, compose, buffered,  # noqa: F401
                         firstn, xmap_readers, cache, batch)
+from .py_reader import PyReader, py_reader  # noqa: F401

@@ -1,0 +1,68 @@
+// RecordIO native kernels: crc32 + record-frame splitting.
+//
+// Capability parity with the reference's C++ recordio library (reference:
+// paddle/fluid/recordio/{header,chunk,scanner,writer}.* — kMagicNumber
+// header.h:23, chunk framing chunk.cc). The chunk header/IO orchestration
+// lives in python (__init__.py); this file carries the byte-crunching hot
+// path (checksum over chunk payloads, splitting a chunk payload into
+// length-prefixed records) so scanning large files does not loop in
+// python. Built lazily with g++ -O2 -shared; __init__.py falls back to
+// pure python (zlib.crc32 + struct) when no compiler is available.
+//
+// Build: g++ -O2 -fPIC -shared -o librecordio.so native.cc
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// CRC-32 (IEEE 802.3, same polynomial as zlib.crc32) with a lazily built
+// table — keeps the .so dependency-free.
+static uint32_t g_table[256];
+static bool g_table_ready = false;
+
+static void build_table() {
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; ++k) {
+      c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    }
+    g_table[i] = c;
+  }
+  g_table_ready = true;
+}
+
+uint32_t rio_crc32(const uint8_t* data, size_t n) {
+  if (!g_table_ready) build_table();
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i) {
+    c = g_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  }
+  return c ^ 0xFFFFFFFFu;
+}
+
+// Split a chunk payload (concatenated [u32-le length | bytes] frames) into
+// (offset, length) pairs. Returns the record count, or -1 on a malformed
+// payload (truncated frame / overflow), or -2 if there are more records
+// than max_records.
+long rio_split_records(const uint8_t* payload, size_t n, uint32_t* offsets,
+                       uint32_t* lengths, size_t max_records) {
+  size_t pos = 0;
+  size_t count = 0;
+  while (pos < n) {
+    if (pos + 4 > n) return -1;
+    uint32_t len;
+    std::memcpy(&len, payload + pos, 4);  // little-endian hosts only (x86/ARM)
+    pos += 4;
+    if (pos + len > n) return -1;
+    if (count >= max_records) return -2;
+    offsets[count] = static_cast<uint32_t>(pos);
+    lengths[count] = len;
+    pos += len;
+    ++count;
+  }
+  return static_cast<long>(count);
+}
+
+}  // extern "C"

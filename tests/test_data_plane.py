@@ -1,0 +1,285 @@
+"""Data plane: RecordIO format, py_reader queue feeding, elastic master
+(reference tests: recordio tests, test_py_reader_*.py, go/master
+service/client tests; kill-recovery mirrors the Go master's task re-issue
+semantics, go/master/service.go:341,455)."""
+
+import os
+import pickle
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, recordio
+from paddle_tpu.master import Master, MasterClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# RecordIO
+# ---------------------------------------------------------------------------
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "data.recordio")
+    records = [f"record-{i}".encode() * (i + 1) for i in range(2500)]
+    n = recordio.write_file(path, records, max_num_records=100)
+    assert n == 2500
+    back = list(recordio.Scanner(path))
+    assert back == records
+
+
+def test_recordio_gzip_and_empty_records(tmp_path):
+    path = str(tmp_path / "z.recordio")
+    records = [b"", b"x", b"", b"longer record" * 50]
+    with recordio.Writer(path, compressor=recordio.GZIP) as w:
+        for r in records:
+            w.write(r)
+    assert list(recordio.Scanner(path)) == records
+
+
+def test_recordio_checksum_detects_corruption(tmp_path):
+    path = str(tmp_path / "c.recordio")
+    recordio.write_file(path, [b"hello world" * 10])
+    raw = bytearray(open(path, "rb").read())
+    raw[-3] ^= 0xFF  # flip a payload byte
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(IOError, match="checksum"):
+        list(recordio.Scanner(path))
+
+
+def test_recordio_python_fallback_matches_native(tmp_path):
+    import paddle_tpu.recordio as rio
+    path = str(tmp_path / "f.recordio")
+    records = [os.urandom(50) for _ in range(200)]
+    rio.write_file(path, records)
+    native = rio._native
+    try:
+        rio._native = False  # force python fallback
+        assert list(rio.Scanner(path)) == records
+    finally:
+        rio._native = native
+    assert list(rio.Scanner(path)) == records
+
+
+# ---------------------------------------------------------------------------
+# elastic master
+# ---------------------------------------------------------------------------
+
+def test_master_task_lifecycle(tmp_path):
+    m = Master("127.0.0.1:0", timeout_dur=60).start()
+    try:
+        c = MasterClient(m.endpoint)
+        c.set_dataset(["a", "b", "c", "d"], chunks_per_task=2)
+        s1, t1 = c.get_task()
+        s2, t2 = c.get_task()
+        assert s1 == s2 == "ok"
+        assert {tuple(t1["payload"]), tuple(t2["payload"])} == {
+            ("a", "b"), ("c", "d")}
+        s3, _ = c.get_task()
+        assert s3 == "none"                     # all leased, none done
+        assert c.task_finished(t1["task_id"], t1["epoch"])
+        assert c.task_finished(t2["task_id"], t2["epoch"])
+        s4, _ = c.get_task()
+        assert s4 == "no_more"                  # pass complete
+        c.start_new_pass()
+        s5, _ = c.get_task()
+        assert s5 == "ok"
+        c.close()
+    finally:
+        m.stop()
+
+
+def test_master_timeout_reissue_and_failure_max():
+    m = Master("127.0.0.1:0", timeout_dur=0.3, failure_max=2,
+               check_interval=0.05).start()
+    try:
+        c = MasterClient(m.endpoint)
+        c.set_dataset(["only"])
+        _, t = c.get_task()
+        time.sleep(0.7)                          # let the lease expire
+        s, t2 = c.get_task()
+        assert s == "ok" and t2["task_id"] == t["task_id"]
+        assert t2["epoch"] > t["epoch"]
+        # the stale first lease can no longer finish the task
+        assert not c.task_finished(t["task_id"], t["epoch"])
+        # fail it past failure_max -> discarded (moves to done)
+        assert c.task_failed(t2["task_id"], t2["epoch"])
+        s, t3 = c.get_task()
+        assert s == "ok"
+        c.task_failed(t3["task_id"], t3["epoch"])  # num_failure=3 > 2
+        s, _ = c.get_task()
+        assert s == "no_more"                    # discarded == pass done
+        c.close()
+    finally:
+        m.stop()
+
+
+def test_master_snapshot_recover(tmp_path):
+    snap = str(tmp_path / "master.json")
+    m = Master("127.0.0.1:0", snapshot_path=snap, timeout_dur=60).start()
+    c = MasterClient(m.endpoint)
+    c.set_dataset(list(range(6)), chunks_per_task=2)
+    _, t = c.get_task()
+    c.task_finished(t["task_id"], t["epoch"])
+    _, t2 = c.get_task()                         # leased but never finished
+    c.close()
+    m.stop()
+
+    m2 = Master("127.0.0.1:0", snapshot_path=snap).start()
+    try:
+        c2 = MasterClient(m2.endpoint)
+        st = c2.stats()
+        # 1 done; the dangling lease went back to todo (reference :166)
+        assert st["done"] == 1 and st["todo"] == 2 and st["pending"] == 0
+        c2.close()
+    finally:
+        m2.stop()
+
+
+MASTER_SCRIPT = """
+import sys
+from paddle_tpu.master import Master
+m = Master(sys.argv[1], timeout_dur=2.0, check_interval=0.2)
+m.serve_forever()
+"""
+
+CONSUMER_SCRIPT = """
+import sys, time
+from paddle_tpu.master import MasterClient
+endpoint, out_path, crash_after = sys.argv[1], sys.argv[2], int(sys.argv[3])
+c = MasterClient(endpoint)
+done = []
+n = 0
+while True:
+    status, task = c.get_task()
+    if status == "no_more":
+        break
+    if status == "none":
+        time.sleep(0.2)
+        continue
+    n += 1
+    if crash_after and n > crash_after:
+        time.sleep(60)   # hold the lease and get SIGKILLed by the parent
+    time.sleep(0.1)      # "process" the task
+    c.task_finished(task["task_id"], task["epoch"])
+    done.extend(task["payload"])
+with open(out_path, "w") as f:
+    f.write(",".join(str(d) for d in done))
+"""
+
+
+def test_master_kill_recovery(tmp_path):
+    """Kill a trainer mid-task: its lease expires and the surviving trainer
+    completes the pass (the P9 elastic property, reference
+    go/master/service.go:341)."""
+    port = _free_port()
+    endpoint = f"127.0.0.1:{port}"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    master = subprocess.Popen([sys.executable, "-c", MASTER_SCRIPT,
+                               endpoint], env=env)
+    victim = survivor = None
+    try:
+        _wait_port(endpoint)
+        c = MasterClient(endpoint)
+        c.set_dataset(list(range(8)))
+        out_v = str(tmp_path / "victim.txt")
+        out_s = str(tmp_path / "survivor.txt")
+        victim = subprocess.Popen([sys.executable, "-c", CONSUMER_SCRIPT,
+                                   endpoint, out_v, "1"], env=env)
+        time.sleep(1.0)  # victim takes a task then hangs on its next one
+        victim.send_signal(signal.SIGKILL)
+        survivor = subprocess.Popen([sys.executable, "-c", CONSUMER_SCRIPT,
+                                     endpoint, out_s, "0"], env=env)
+        survivor.wait(timeout=60)
+        assert survivor.returncode == 0
+        st = c.stats()
+        assert st["done"] == 8 and st["todo"] == 0 and st["pending"] == 0
+        c.close()
+    finally:
+        for p in (victim, survivor, master):
+            if p is not None and p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_port(endpoint, timeout=30):
+    host, port = endpoint.rsplit(":", 1)
+    deadline = time.time() + timeout
+    while True:
+        try:
+            socket.create_connection((host, int(port)), timeout=1).close()
+            return
+        except OSError:
+            if time.time() > deadline:
+                raise TimeoutError(endpoint)
+            time.sleep(0.2)
+
+
+# ---------------------------------------------------------------------------
+# py_reader: train from a RecordIO file
+# ---------------------------------------------------------------------------
+
+def test_py_reader_trains_from_recordio(tmp_path):
+    """The full data-plane slice: RecordIO file -> master-free reader ->
+    py_reader queue -> exe.run(feed=None) -> EOFException per epoch."""
+    path = str(tmp_path / "train.recordio")
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(4, 1).astype(np.float32)
+    samples = []
+    for _ in range(96):
+        x = rng.randn(4).astype(np.float32)
+        y = (x @ w_true).astype(np.float32)
+        samples.append(pickle.dumps((x, y)))
+    recordio.write_file(path, samples)
+
+    reader, (xv, yv) = fluid.reader.py_reader(
+        capacity=8, shapes=[[-1, 4], [-1, 1]],
+        dtypes=["float32", "float32"])
+    pred = layers.fc(input=xv, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, yv))
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    def batches():
+        batch = []
+        for rec in recordio.Scanner(path):
+            batch.append(pickle.loads(rec))
+            if len(batch) == 16:
+                xs = np.stack([b[0] for b in batch])
+                ys = np.stack([b[1] for b in batch])
+                yield {xv.name: xs, yv.name: ys}
+                batch = []
+
+    reader.decorate_tensor_provider(batches)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    epoch_losses = []
+    for epoch in range(4):
+        reader.start()
+        losses = []
+        while True:
+            try:
+                l, = exe.run(feed=None, fetch_list=[loss])
+            except fluid.EOFException:
+                reader.reset()
+                break
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+        assert len(losses) == 6  # 96 / 16
+        epoch_losses.append(np.mean(losses))
+    assert epoch_losses[-1] < epoch_losses[0] * 0.5, epoch_losses
