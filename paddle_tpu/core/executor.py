@@ -17,6 +17,7 @@ dispatch.
 
 from __future__ import annotations
 
+import itertools
 import logging
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -76,10 +77,14 @@ CUDAPlace = TPUPlace
 class Scope:
     """Hierarchical name -> array holder (reference scope.h:39)."""
 
+    _uid_counter = itertools.count()
+
     def __init__(self, parent: Optional["Scope"] = None):
         self._vars: Dict[str, Any] = {}
         self._parent = parent
         self._kids: List[Scope] = []
+        # process-unique id for executor cache keys (id() recycles after GC)
+        self._uid = next(Scope._uid_counter)
 
     def new_scope(self) -> "Scope":
         kid = Scope(self)
@@ -141,12 +146,14 @@ class _CompiledProgram:
     """One lowered+jitted step for a (program version, feed/fetch set)."""
 
     def __init__(self, program: ir.Program, feed_names, fetch_names, scope: Scope,
-                 donate: bool, amp: bool = False):
+                 donate: bool, amp: bool = False, check_nan_inf: bool = False):
         self.program = program
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
+        self.check_nan_inf = check_nan_inf
+        self._nan_meta = []
         block = program.global_block()
-        lowerer = BlockLowerer(program, amp=amp)
+        lowerer = BlockLowerer(program, amp=amp, check_nan_inf=check_nan_inf)
 
         # Statically determine which scope vars the block reads/writes.
         written: List[str] = []
@@ -193,10 +200,16 @@ class _CompiledProgram:
             env.update(const_state)
             env.update(mut_state)
             env.update(feeds)
+            lowerer.nan_flags = []
             lowerer.run_block(0, env, key)
             fetches = [env[n] for n in self.fetch_names]
             new_state = {n: env[n] for n in written if n in env}
-            return fetches, new_state
+            # trace-time side effect: remember which (op, var) each flag
+            # belongs to so the host can name the offender
+            self._nan_meta = [(t, n) for t, n, _ in lowerer.nan_flags]
+            flags = ([f for _, _, f in lowerer.nan_flags]
+                     if lowerer.check_nan_inf else [])
+            return fetches, new_state, flags
 
         donate_args = (1,) if donate else ()
         self._step = jax.jit(step, donate_argnums=donate_args)
@@ -204,9 +217,18 @@ class _CompiledProgram:
     def run(self, scope: Scope, feeds: Dict[str, Any], key):
         mut = {n: scope.find_var(n) for n in self.mut_names}
         const = {n: scope.find_var(n) for n in self.const_names}
-        fetches, new_state = self._step(feeds, mut, const, key)
+        fetches, new_state, flags = self._step(feeds, mut, const, key)
         for n, v in new_state.items():
             scope.set_var(n, v)
+        if self.check_nan_inf and flags:
+            finite = np.asarray(jnp.stack(flags))
+            if not finite.all():
+                bad = int(np.argmin(finite))
+                op_type, var = self._nan_meta[bad]
+                raise RuntimeError(
+                    f"NaN/Inf detected in output {var!r} of op "
+                    f"{op_type!r} (check_nan_inf mode; reference "
+                    f"CheckTensorNANOrInf, operator.cc:622)")
         return fetches
 
 
@@ -217,11 +239,28 @@ class Executor:
     matches the reference API. Programs are compiled on first run and cached.
     """
 
-    def __init__(self, place: Optional[Place] = None, amp: bool = False):
+    def __init__(self, place: Optional[Place] = None, amp: bool = False,
+                 check_nan_inf: Optional[bool] = None):
         self.place = place or TPUPlace(0)
         self.amp = amp  # bf16 mixed precision (reference float16_transpiler analog)
+        # debug mode: per-op finite checks (reference FLAGS_check_nan_inf).
+        # None = follow the flag registry at run time, so
+        # set_flag("check_nan_inf", True) takes effect on the next run
+        # (a new cache entry compiles with the checks baked in).
+        self._check_nan_inf = check_nan_inf
         self._cache: Dict[tuple, _CompiledProgram] = {}
         self._run_counter = 0
+
+    @property
+    def check_nan_inf(self) -> bool:
+        if self._check_nan_inf is None:
+            from .. import flags as _flags
+            return _flags.get_flag("check_nan_inf")
+        return self._check_nan_inf
+
+    @check_nan_inf.setter
+    def check_nan_inf(self, value):
+        self._check_nan_inf = value
 
     def run(self,
             program: Optional[ir.Program] = None,
@@ -267,14 +306,16 @@ class Executor:
             else:
                 feed_arrays[name] = _as_feed_array(val, var)
 
-        cache_key = (id(program), program._version, tuple(sorted(feed_arrays)),
-                     tuple(fetch_names), id(scope), self.amp)
+        cache_key = (program._uid, program._version,
+                     tuple(sorted(feed_arrays)), tuple(fetch_names),
+                     scope._uid, self.amp, self.check_nan_inf)
         compiled = self._cache.get(cache_key) if use_program_cache else None
         if compiled is None:
             with jax.default_device(self.place.jax_device()):
                 compiled = _CompiledProgram(program, sorted(feed_arrays),
                                             fetch_names, scope, donate=True,
-                                            amp=self.amp)
+                                            amp=self.amp,
+                                            check_nan_inf=self.check_nan_inf)
             if use_program_cache:
                 self._cache[cache_key] = compiled
 

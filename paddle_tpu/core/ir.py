@@ -15,6 +15,7 @@ to describe dataflow, not execution.
 from __future__ import annotations
 
 import copy
+import itertools
 import json
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -258,10 +259,15 @@ class Program:
     lowerings per (program, version).
     """
 
+    _uid_counter = itertools.count()
+
     def __init__(self):
         self.blocks: List[Block] = [Block(self, 0)]
         self._current_block_idx = 0
         self._version = 0
+        # process-unique id for executor cache keys: id() can be recycled
+        # after GC and serve a stale compiled step
+        self._uid = next(Program._uid_counter)
         self._seed: Optional[int] = None  # random_seed analog
         self._is_inference = False
 
@@ -307,6 +313,11 @@ class Program:
                 if isinstance(src, Parameter) and isinstance(dst, Parameter):
                     dst.regularizer = src.regularizer
                     dst.gradient_clip = src.gradient_clip
+                    dst.sharding = src.sharding
+                    dst.trainable = src.trainable
+                    dst.is_distributed = src.is_distributed
+                    if hasattr(src, "optimize_attr"):
+                        dst.optimize_attr = dict(src.optimize_attr)
         if for_test:
             p._set_inference_mode()
         return p
@@ -329,7 +340,10 @@ class Program:
 
     def _prune(self, targets: Sequence[str]) -> "Program":
         """Backward-slice the global block to ops needed for `targets`
-        (reference: framework/prune.cc:181)."""
+        (reference: framework/prune.cc:181). A kept control-flow op keeps
+        its whole sub-block tree, and the sub-blocks' external reads join
+        the needed set — otherwise a While/StaticRNN body's producers in
+        the global block would be mis-pruned."""
         p = self.clone()
         blk = p.global_block()
         needed = set(targets)
@@ -338,8 +352,13 @@ class Program:
             if needed & set(op.output_arg_names) or op.type in ("feed", "fetch"):
                 keep.append(op)
                 needed |= set(op.input_arg_names)
+                for si in sub_block_indices(op):
+                    needed |= set(external_reads(p, si))
         blk.ops = list(reversed(keep))
         used = {n for op in blk.ops for n in op.input_arg_names + op.output_arg_names}
+        for op in blk.ops:
+            for si in sub_block_indices(op):
+                used |= set(external_reads(p, si))
         blk.vars = {k: v for k, v in blk.vars.items() if k in used or v.persistable}
         p._bump()
         return p

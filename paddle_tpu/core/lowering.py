@@ -28,18 +28,35 @@ from .registry import EMPTY_VAR, FWD_OP_ATTR, GRAD_OP_SUFFIX, LoweringContext
 class BlockLowerer:
     """Lowers a Block's op list into a pure function over an env dict."""
 
-    def __init__(self, program: ir.Program, amp: bool = False):
+    def __init__(self, program: ir.Program, amp: bool = False,
+                 check_nan_inf: bool = False):
         self.program = program
         # bf16 mixed precision for MXU ops (registry.AMP_OPS); params stay
         # fp32, accumulation is fp32 on the MXU.
         self.amp = amp
+        # reference FLAGS_check_nan_inf (CheckTensorNANOrInf after every op,
+        # operator.cc:622-634). XLA programs cannot raise, so each op's
+        # float outputs contribute an all-finite flag; the executor checks
+        # the flags on the host after the step and raises naming the first
+        # offending (op, var).
+        self.check_nan_inf = check_nan_inf
+        self.nan_flags: List[tuple] = []  # (op_type, var_name, flag) per trace
+        # control-flow sub-blocks lower inside lax.scan/while/cond body
+        # traces where a recorded flag would be a leaked tracer; interior
+        # ops are therefore covered at the control-flow op's boundary
+        # (its outputs are checked at depth 1)
+        self._block_depth = 0
 
     def run_block(self, block_idx: int, env: Dict[str, Any], key) -> Dict[str, Any]:
         """Execute all ops of `block_idx` on `env` (name -> jnp array),
         mutating and returning it. `key` is the step's base PRNG key."""
         block = self.program.blocks[block_idx]
-        for op_idx, op in enumerate(block.ops):
-            self._run_op(block, op, op_idx, env, key)
+        self._block_depth += 1
+        try:
+            for op_idx, op in enumerate(block.ops):
+                self._run_op(block, op, op_idx, env, key)
+        finally:
+            self._block_depth -= 1
         return env
 
     # -- single op -------------------------------------------------------
@@ -47,6 +64,8 @@ class BlockLowerer:
                 env: Dict[str, Any], key):
         if op.type.endswith(GRAD_OP_SUFFIX) and FWD_OP_ATTR in op.attrs:
             self._run_grad_op(block, op, env, key)
+            if self.check_nan_inf and self._block_depth == 1:
+                self._record_nan_flags_env(op, env)
             return
         opdef = registry.get_op_def(op.type)
         op_key = jax.random.fold_in(key, _op_seed(op, op_idx)) if opdef.needs_rng else None
@@ -56,6 +75,26 @@ class BlockLowerer:
         _scatter_outputs(op, outs, env)
         if opdef.propagate_seqlen:
             _propagate_seqlen(op, env)
+        if self.check_nan_inf and self._block_depth == 1:
+            self._record_nan_flags(op, outs)
+
+    def _record_nan_flags(self, op, outs):
+        for slot, names in op.outputs.items():
+            for name, val in zip(names, outs.get(slot, [])):
+                self._record_one_flag(op.type, name, val)
+
+    def _record_nan_flags_env(self, op, env):
+        # grad ops scatter their outputs straight into env (vjp path);
+        # check whatever actually got written
+        for name in op.output_arg_names:
+            self._record_one_flag(op.type, name, env.get(name))
+
+    def _record_one_flag(self, op_type, name, val):
+        if val is None or not hasattr(val, "dtype"):
+            return
+        if jnp.issubdtype(jnp.asarray(val).dtype, jnp.floating):
+            self.nan_flags.append(
+                (op_type, name, jnp.all(jnp.isfinite(val))))
 
     # -- generic vjp-based grad op --------------------------------------
     def _run_grad_op(self, block: ir.Block, op: ir.Operator,

@@ -147,13 +147,13 @@ class ParallelExecutor:
             if isinstance(val, (tuple, list)) and len(val) == 2 and var is not None \
                     and var.lod_level > 0:
                 data, lens = val
-                feed_arrays[name] = self._shard_feed(np.asarray(data))
+                feed_arrays[name] = self._shard_feed(np.asarray(data), var)
                 feed_arrays[ir.seqlen_var_name(name)] = self._shard_feed(
-                    np.asarray(lens, np.int32))
+                    np.asarray(lens, np.int32), var)
             else:
-                feed_arrays[name] = self._shard_feed(np.asarray(val))
+                feed_arrays[name] = self._shard_feed(np.asarray(val), var)
 
-        key = (id(self._program), self._program._version,
+        key = (self._program._uid, self._program._version,
                tuple(sorted(feed_arrays)), tuple(fetch_names))
         compiled = self._cache.get(key)
         if compiled is None:
@@ -170,9 +170,21 @@ class ParallelExecutor:
             fetches = [np.asarray(f) for f in fetches]
         return fetches
 
-    def _shard_feed(self, arr: np.ndarray):
+    def _shard_feed(self, arr: np.ndarray, var=None):
         ndev = self.device_count
-        if arr.ndim == 0 or arr.shape[0] % ndev != 0:
+        if arr.ndim == 0:
+            return jax.device_put(arr, self._replicated)
+        if arr.shape[0] % ndev != 0:
+            if var is None or var.is_data:
+                # a silently replicated DATA feed would train every device
+                # on the SAME rows — a correctness bug, not a fallback
+                # (reference PE enforces divisibility via data_balance)
+                raise ValueError(
+                    f"feed batch dim {arr.shape[0]} is not divisible by the "
+                    f"{ndev}-device data-parallel mesh; pad or drop the tail "
+                    f"batch (reader.batch(..., drop_last=True))")
+            # non-data feeds (lr schedules, class weights, ...) have no
+            # batch dimension — replicate
             return jax.device_put(arr, self._replicated)
         spec = [None] * arr.ndim
         spec[0] = "dp"

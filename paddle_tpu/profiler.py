@@ -37,11 +37,17 @@ def profiler(state="All", sorted_key=None, profile_path="/tmp/profile"):
 
 @contextlib.contextmanager
 def record_event(name: str):
-    """reference platform::RecordEvent analog -> jax named annotation."""
+    """reference platform::RecordEvent analog -> jax named annotation.
+    Events also land in the host table (print_host_events) and the chrome
+    trace export (export_chrome_tracing)."""
     with jax.profiler.TraceAnnotation(name):
         t0 = time.time()
-        yield
-        _events.append((name, time.time() - t0))
+        try:
+            yield
+        finally:
+            # record even when the body raises — the failing iteration is
+            # usually the one being profiled
+            _events.append((name, t0, time.time() - t0))
 
 
 def start_profiler(state="All", profile_path="/tmp/profile"):
@@ -64,12 +70,40 @@ def cuda_profiler(*a, **kw):
     yield
 
 
-def print_host_events():
-    agg = defaultdict(lambda: [0, 0.0])
-    for name, dt in _events:
-        agg[name][0] += 1
-        agg[name][1] += dt
-    rows = sorted(agg.items(), key=lambda kv: -kv[1][1])
-    print(f"{'Event':<40} {'Calls':>8} {'Total(s)':>12} {'Avg(ms)':>10}")
-    for name, (calls, total) in rows:
-        print(f"{name:<40} {calls:>8} {total:>12.4f} {1000*total/calls:>10.3f}")
+def print_host_events(sorted_key="total"):
+    """Aggregated host-event table (reference DisableProfiler's printed
+    table, profiler.cc:448). Device-level op times live in the XLA trace
+    captured by `profiler` (TensorBoard/perfetto) — under jit there are no
+    per-op kernel launches to time on the host, by design."""
+    agg = defaultdict(lambda: [0, 0.0, 0.0, float("inf")])
+    for name, _t0, dt in _events:
+        a = agg[name]
+        a[0] += 1
+        a[1] += dt
+        a[2] = max(a[2], dt)
+        a[3] = min(a[3], dt)
+    keyfn = {"total": lambda kv: -kv[1][1], "calls": lambda kv: -kv[1][0],
+             "max": lambda kv: -kv[1][2], "min": lambda kv: kv[1][3],
+             "ave": lambda kv: -kv[1][1] / kv[1][0]}.get(
+        sorted_key, lambda kv: -kv[1][1])
+    rows = sorted(agg.items(), key=keyfn)
+    print(f"{'Event':<40} {'Calls':>8} {'Total(s)':>12} {'Avg(ms)':>10} "
+          f"{'Max(ms)':>10} {'Min(ms)':>10}")
+    for name, (calls, total, mx, mn) in rows:
+        print(f"{name:<40} {calls:>8} {total:>12.4f} "
+              f"{1000 * total / calls:>10.3f} {1000 * mx:>10.3f} "
+              f"{1000 * mn:>10.3f}")
+    return rows
+
+
+def export_chrome_tracing(path: str):
+    """Write recorded host events as chrome://tracing JSON (reference
+    tools/timeline.py:21 converts the profiler proto the same way; device
+    timelines come from the perfetto trace jax.profiler writes)."""
+    import json
+    events = [{"name": name, "ph": "X", "pid": 0, "tid": 0,
+               "ts": int(t0 * 1e6), "dur": int(dt * 1e6),
+               "cat": "host"} for name, t0, dt in _events]
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return path
