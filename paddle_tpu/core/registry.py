@@ -129,12 +129,25 @@ class LoweringContext:
 AMP_BF16_OPS = frozenset({"conv2d", "depthwise_conv2d", "conv2d_transpose",
                           "mul", "matmul", "lstm", "gru", "fc",
                           "fused_attention"})
-AMP_F32_OPS = frozenset({"softmax", "log_softmax", "cross_entropy",
+# NOTE: plain `softmax` deliberately NOT f32-listed: jax.nn.softmax is
+# max-subtracted so bf16 is safe, and an f32 round trip on [B,H,T,T]
+# attention weights doubles the dominant HBM traffic of unfused attention.
+# The loss-adjacent softmaxes (softmax_with_cross_entropy & co) stay f32.
+AMP_F32_OPS = frozenset({"log_softmax", "cross_entropy",
                          "softmax_with_cross_entropy",
                          "sigmoid_cross_entropy_with_logits",
                          "square_error_cost", "smooth_l1", "huber_loss",
                          "mean", "reduce_mean", "nce", "hierarchical_sigmoid",
                          "linear_chain_crf", "warpctc", "cos_sim"})
+# Mixed-dtype elementwise ops downcast the f32 side to bf16 instead of
+# letting numpy promotion upcast the bf16 side: one f32 mask/bias/table
+# leaking into the residual or attention-score stream would otherwise
+# promote every downstream tensor to f32 and double its HBM traffic.
+# bf16 keeps the full f32 exponent range, so additive masks (-1e9) and
+# scales survive the downcast.
+AMP_DOWNCAST_OPS = frozenset({"elementwise_add", "elementwise_sub",
+                              "elementwise_mul", "elementwise_div",
+                              "elementwise_max", "elementwise_min"})
 # Back-compat alias (older tests/tools referenced AMP_OPS).
 AMP_OPS = AMP_BF16_OPS
 
@@ -150,6 +163,12 @@ def call_rule(opdef: OpDef, ctx: LoweringContext, ins_by_slot: Dict[str, List[An
     amp_on = ctx.lowerer is not None and getattr(ctx.lowerer, "amp", False)
     to_bf16 = amp_on and opdef.type in AMP_BF16_OPS
     to_f32 = amp_on and opdef.type in AMP_F32_OPS
+    if amp_on and not to_bf16 and not to_f32 and opdef.type in AMP_DOWNCAST_OPS:
+        dtypes = {jnp.dtype(v.dtype)
+                  for vals in ins_by_slot.values() for v in vals
+                  if hasattr(v, "dtype")}
+        to_bf16 = (jnp.dtype(jnp.bfloat16) in dtypes
+                   and jnp.dtype(jnp.float32) in dtypes)
     kwargs = {}
     for slot in opdef.input_slots:
         vals = ins_by_slot.get(slot)

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..core.registry import register_op
@@ -181,13 +182,73 @@ def _dropout(ctx, X):
     if is_test:
         out = X if impl == "upscale_in_train" else X * (1.0 - p)
         return {"Out": out, "Mask": jnp.ones_like(X)}
-    keep = jax.random.bernoulli(ctx.key, 1.0 - p, X.shape)
-    mask = keep.astype(X.dtype)
-    if impl == "upscale_in_train":
-        out = jnp.where(keep, X / (1.0 - p), 0.0)
-    else:
-        out = X * mask
-    return {"Out": out, "Mask": mask}
+    if p >= 1.0:
+        # degenerate: drop everything (upscale would divide by zero)
+        return {"Out": jnp.zeros_like(X), "Mask": jnp.zeros_like(X)}
+    # Hot path: Pallas kernel with in-kernel TPU PRNG — XLA's counter-based
+    # RNG is a long VPU integer chain that dominated transformer step time
+    # (reference dropout_op.cu pays the same via cuRAND but on idle SMs).
+    # The kernel's custom_vjp regenerates the mask from the seed, so no
+    # mask tensor ever hits HBM.
+    from . import pallas_dropout
+    # ndim <= 3 ~ residual-stream activations, where the kernel replaces a
+    # whole XLA RNG chain with an HBM-speed pass. 4-D attention weights
+    # stay on the XLA path: their dropout sits between the score softmax
+    # and the A@V matmul and fuses into that chain, which beats paying a
+    # pallas_call materialization boundary there.
+    if (impl == "upscale_in_train" and jax.default_backend() != "cpu"
+            and X.ndim <= 3 and pallas_dropout.supports(X, p)):
+        seed = (jax.random.key_data(ctx.key).reshape(-1)[0]
+                .astype(jnp.int32).reshape(1, 1))
+        out = pallas_dropout.dropout_tpu(X, seed, float(p))
+        # The true keep mask, regenerated from the same seed over a
+        # never-zero input. It's an independent expression, so XLA DCEs
+        # it when nothing consumes the Mask output (the backward doesn't:
+        # the vjp re-derives the mask in-kernel).
+        mask = (pallas_dropout.dropout_tpu(
+            jnp.ones(X.shape, jnp.float32), seed, float(p)) != 0)
+        return {"Out": out, "Mask": mask.astype(X.dtype)}
+    # XLA fallback: uint8 bit-compare instead of bernoulli (bernoulli
+    # materializes a full f32 uniform tensor; one random byte per element
+    # decides keep at 1/256 resolution and fuses into the chain at a
+    # quarter of the RNG traffic). custom_vjp regenerates the bits in the
+    # backward so the mask is never stored as a residual.
+    scale = 1.0 if impl != "upscale_in_train" else 1.0 / (1.0 - p)
+    out = _bits_dropout(X, ctx.key, float(p), float(scale))
+    # true keep mask from the same key; DCE'd when the Mask var is unused
+    mask = _keep_bits(ctx.key, X.shape, float(p))
+    return {"Out": out, "Mask": mask.astype(X.dtype)}
+
+
+def _keep_bits(key, shape, p):
+    t = round((1.0 - p) * 256) - 1
+    if t < 0:                       # p ~ 1: nothing survives
+        return jnp.zeros(shape, bool)
+    return jax.random.bits(key, shape, np.uint8) <= np.uint8(min(255, t))
+
+
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _bits_dropout(x, key, p, scale):
+    keep = _keep_bits(key, x.shape, p)
+    return jnp.where(keep, x * jnp.asarray(scale, x.dtype), jnp.zeros_like(x))
+
+
+def _bits_dropout_fwd(x, key, p, scale):
+    return _bits_dropout(x, key, p, scale), key
+
+
+def _bits_dropout_bwd(p, scale, key, dy):
+    keep = _keep_bits(key, dy.shape, p)   # regenerated, not stored
+    dx = jnp.where(keep, dy * jnp.asarray(scale, dy.dtype),
+                   jnp.zeros_like(dy))
+    dkey = np.zeros(jnp.shape(key), jax.dtypes.float0)
+    return dx, dkey
+
+
+_bits_dropout.defvjp(_bits_dropout_fwd, _bits_dropout_bwd)
 
 
 @register_op("lrn", propagate_seqlen=False)
