@@ -27,6 +27,7 @@ from . import nets  # noqa: F401
 from . import metrics  # noqa: F401
 from . import io  # noqa: F401
 from . import profiler  # noqa: F401
+from . import debugger  # noqa: F401
 from . import flags  # noqa: F401
 from .flags import get_flag, set_flag  # noqa: F401
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401

@@ -1,0 +1,36 @@
+"""Build libpaddle_tpu_capi.so (reference analog: the capi cmake target,
+legacy/capi/CMakeLists.txt). Uses python3-config for the embed flags;
+pybind11 is deliberately not required — the shim is plain CPython C API.
+
+Usage: python paddle_tpu/capi/build.py [outdir]
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+
+def build(outdir: str | None = None) -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    outdir = outdir or here
+    os.makedirs(outdir, exist_ok=True)
+    out = os.path.join(outdir, "libpaddle_tpu_capi.so")
+    include = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ldlib = sysconfig.get_config_var("LDLIBRARY") or ""
+    # embed link flags: prefer python3-config --embed when available
+    ldflags = [f"-L{libdir}"] if libdir else []
+    ver = f"python{sys.version_info.major}.{sys.version_info.minor}"
+    ldflags.append(f"-l{ver}")
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+           os.path.join(here, "capi.cc"), f"-I{include}", f"-I{here}",
+           "-o", out] + ldflags
+    subprocess.run(cmd, check=True)
+    return out
+
+
+if __name__ == "__main__":
+    print(build(sys.argv[1] if len(sys.argv) > 1 else None))

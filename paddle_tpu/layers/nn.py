@@ -212,6 +212,15 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
     helper = LayerHelper("conv2d_transpose", **locals())
     dtype = input.dtype
     num_channels = input.shape[1]
+    if filter_size is None:
+        # derive from output_size (reference nn.py:2377-2390)
+        if output_size is None:
+            raise ValueError("filter_size or output_size must be set")
+        osz = [output_size] * 2 if isinstance(output_size, int) \
+            else list(output_size)
+        st, pd, dl = _pair(stride), _pair(padding), _pair(dilation)
+        filter_size = [(osz[i] - (input.shape[2 + i] - 1) * st[i]
+                        + 2 * pd[i] - 1) // dl[i] + 1 for i in range(2)]
     fsize = filter_size if isinstance(filter_size, (list, tuple)) \
         else [filter_size, filter_size]
     filter_shape = [num_channels, num_filters] + list(fsize)
@@ -760,3 +769,400 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
                      outputs={"Y": [out.name]},
                      attrs={"maxlen": maxlen if maxlen else -1, "out_dtype": dtype})
     return out
+
+
+# ---------------------------------------------------------------------------
+# breadth layers completing the reference nn.py surface (3-D, image, misc)
+# ---------------------------------------------------------------------------
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, act=None, name=None):
+    """reference nn.py conv3d."""
+    helper = LayerHelper("conv3d", **locals())
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size] * 3
+    in_c = input.shape[1]
+    g = groups or 1
+    w = helper.create_parameter(param_attr,
+                                [num_filters, in_c // g] + list(k),
+                                input.dtype,
+                                default_initializer=init.MSRAInitializer())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    inputs = {"Input": [input.name], "Filter": [w.name]}
+    if bias_attr is not False:
+        b = helper.create_parameter(helper.bias_attr, [num_filters],
+                                    input.dtype, is_bias=True)
+        inputs["Bias"] = [b.name]
+    helper.append_op("conv3d", inputs=inputs,
+                     outputs={"Output": [out.name]},
+                     attrs={"strides": list(_triple3(stride)),
+                            "paddings": list(_triple3(padding)),
+                            "dilations": list(_triple3(dilation)),
+                            "groups": g})
+    return helper.append_activation(out)
+
+
+def _triple3(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v,) * 3
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, param_attr=None,
+                     bias_attr=None, act=None, name=None):
+    """reference nn.py conv3d_transpose."""
+    helper = LayerHelper("conv3d_transpose", **locals())
+    stride3 = _triple3(stride)
+    pad3 = _triple3(padding)
+    dil3 = _triple3(dilation)
+    if filter_size is None:
+        # reference conv2d_transpose:2377 derives the kernel from the
+        # requested output: k = (out - (in-1)*s + 2p - 1)/d + 1
+        if output_size is None:
+            raise ValueError("filter_size or output_size must be set")
+        osz = [output_size] * 3 if isinstance(output_size, int) \
+            else list(output_size)
+        k = [(osz[i] - (input.shape[2 + i] - 1) * stride3[i]
+              + 2 * pad3[i] - 1) // dil3[i] + 1 for i in range(3)]
+    else:
+        k = filter_size if isinstance(filter_size, (list, tuple)) \
+            else [filter_size] * 3
+    in_c = input.shape[1]
+    w = helper.create_parameter(param_attr, [in_c, num_filters] + list(k),
+                                input.dtype,
+                                default_initializer=init.XavierInitializer())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    inputs = {"Input": [input.name], "Filter": [w.name]}
+    if bias_attr is not False:
+        b = helper.create_parameter(helper.bias_attr, [num_filters],
+                                    input.dtype, is_bias=True)
+        inputs["Bias"] = [b.name]
+    helper.append_op("conv3d_transpose", inputs=inputs,
+                     outputs={"Output": [out.name]},
+                     attrs={"strides": list(_triple3(stride)),
+                            "paddings": list(_triple3(padding)),
+                            "dilations": list(_triple3(dilation))})
+    return helper.append_activation(out)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, name=None):
+    helper = LayerHelper("pool3d", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("pool3d", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"pooling_type": pool_type,
+                            "ksize": list(_triple3(pool_size)),
+                            "strides": list(_triple3(pool_stride)),
+                            "paddings": list(_triple3(pool_padding)),
+                            "global_pooling": global_pooling})
+    return out
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR"):
+    """reference nn.py image_resize (BILINEAR/NEAREST)."""
+    helper = LayerHelper("image_resize", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    attrs = {"interp_method": resample.lower()}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), int(out_shape[1])
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op("bilinear_interp", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]}, attrs=attrs)
+    return out
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None):
+    return image_resize(input, out_shape, scale, name, resample="BILINEAR")
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Resize so the SHORT side equals out_short_len (reference
+    nn.py image_resize_short), preserving aspect ratio."""
+    h, w = input.shape[2], input.shape[3]
+    short, is_h = (h, True) if h < w else (w, False)
+    ratio = out_short_len / float(short)
+    out_shape = ([out_short_len, int(round(w * ratio))] if is_h
+                 else [int(round(h * ratio)), out_short_len])
+    return image_resize(input, out_shape=out_shape, resample=resample)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    helper = LayerHelper("crop", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    inputs = {"X": [x.name]}
+    attrs = {}
+    if isinstance(shape, ir.Variable):
+        inputs["Y"] = [shape.name]
+    else:
+        attrs["shape"] = list(shape)
+    if offsets is not None:
+        attrs["offsets"] = list(offsets)
+    helper.append_op("crop", inputs=inputs, outputs={"Out": [out.name]},
+                     attrs=attrs)
+    return out
+
+
+def random_crop(x, shape, seed=None):
+    helper = LayerHelper("random_crop")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("random_crop", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"shape": list(shape)})
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    helper = LayerHelper("label_smooth", name=name)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    inputs = {"X": [label.name]}
+    if prior_dist is not None:
+        inputs["PriorDist"] = [prior_dist.name]
+    helper.append_op("label_smooth", inputs=inputs,
+                     outputs={"Out": [out.name]},
+                     attrs={"epsilon": float(epsilon)})
+    return out
+
+
+def multiplex(inputs, index):
+    helper = LayerHelper("multiplex")
+    out = helper.create_variable_for_type_inference(dtype=inputs[0].dtype)
+    helper.append_op("multiplex",
+                     inputs={"X": [v.name for v in inputs],
+                             "Ids": [index.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def rank_loss(label, left, right, name=None):
+    helper = LayerHelper("rank_loss", name=name)
+    out = helper.create_variable_for_type_inference(dtype=left.dtype)
+    helper.append_op("rank_loss",
+                     inputs={"Label": [label.name], "Left": [left.name],
+                             "Right": [right.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """reference nn.py dice_loss — composed from elementwise layers the
+    same way the reference composes it (math_op_patch overloads)."""
+    from . import ops as _ops
+    from .tensor import cast
+    label_f = cast(label, input.dtype)
+    # per-sample dice averaged over the batch (reference nn.py:4843-4851
+    # reduces over dims 1.. then reduce_mean) — a global pool would let
+    # large masks dominate small ones
+    dims = list(range(1, len(input.shape)))
+    inter = reduce_sum(_ops.elementwise_mul(input, label_f), dim=dims)
+    union = reduce_sum(input, dim=dims) + reduce_sum(label_f, dim=dims)
+    dice = scale(inter, scale=2.0) / (union + epsilon)
+    return reduce_mean(scale(dice, scale=-1.0, bias=1.0))
+
+
+def mean_iou(input, label, num_classes):
+    helper = LayerHelper("mean_iou")
+    miou = helper.create_variable_for_type_inference(dtype="float32")
+    wrong = helper.create_variable_for_type_inference(dtype="int32")
+    correct = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op("mean_iou",
+                     inputs={"Predictions": [input.name],
+                             "Labels": [label.name]},
+                     outputs={"OutMeanIou": [miou.name],
+                              "OutWrong": [wrong.name],
+                              "OutCorrect": [correct.name]},
+                     attrs={"num_classes": int(num_classes)})
+    return miou, wrong, correct
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0):
+    helper = LayerHelper("roi_pool")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("roi_pool",
+                     inputs={"X": [input.name], "ROIs": [rois.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"pooled_height": int(pooled_height),
+                            "pooled_width": int(pooled_width),
+                            "spatial_scale": float(spatial_scale)})
+    return out
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """reference nn.py ctc_greedy_decoder. Returns padded ids [B, T]; the
+    decoded lengths ride the @SEQLEN companion (reference emits LoD)."""
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    out = helper.create_variable_for_type_inference(dtype="int32")
+    lens = helper.create_variable_for_type_inference(dtype="int32")
+    inputs = _seq_inputs(helper, input)
+    helper.append_op("ctc_greedy_decoder", inputs=inputs,
+                     outputs={"Out": [out.name], "OutLen": [lens.name]},
+                     attrs={"blank": int(blank)})
+    out.lod_level = 1
+    blk = helper.main_program.current_block()
+    comp = blk.create_var(name=seqlen_var_name(out.name), shape=[-1],
+                          dtype="int32")
+    helper.append_op("assign", inputs={"X": [lens.name]},
+                     outputs={"Out": [comp.name]})
+    return out, lens
+
+
+def lod_reset(x, y=None, target_lod=None):
+    helper = LayerHelper("lod_reset")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    inputs = {"X": [x.name]}
+    attrs = {}
+    if y is not None:
+        inputs["Y"] = [y.name]
+    elif target_lod is not None:
+        attrs["target_lod"] = list(target_lod)
+    else:
+        raise ValueError("lod_reset needs y or target_lod")
+    helper.append_op("lod_reset", inputs=inputs,
+                     outputs={"Out": [out.name]}, attrs=attrs)
+    out.lod_level = max(1, x.lod_level)
+    return out
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None):
+    """reference nn.py chunk_eval -> (precision, recall, f1, #infer,
+    #label, #correct)."""
+    helper = LayerHelper("chunk_eval")
+    names = ["Precision", "Recall", "F1-Score", "NumInferChunks",
+             "NumLabelChunks", "NumCorrectChunks"]
+    dtypes = ["float32", "float32", "float32", "int32", "int32", "int32"]
+    outs = {s: [helper.create_variable_for_type_inference(dtype=d).name]
+            for s, d in zip(names, dtypes)}
+    inputs = _seq_inputs(helper, input, {"Label": [label.name]})
+    helper.append_op("chunk_eval", inputs=inputs, outputs=outs,
+                     attrs={"num_chunk_types": int(num_chunk_types),
+                            "chunk_scheme": chunk_scheme,
+                            "excluded_chunk_types":
+                                list(excluded_chunk_types or [])})
+    blk = helper.main_program.current_block()
+    return tuple(blk.var(outs[s][0]) for s in names)
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """Single LSTM step (reference nn.py lstm_unit:2819): fc on
+    [x_t, h_prev] then the lstm_unit op."""
+    helper = LayerHelper("lstm_unit", **locals())
+    size = cell_t_prev.shape[-1]
+    from .tensor import concat
+    cat = concat([x_t, hidden_t_prev], axis=1)
+    fc_out = fc(input=cat, size=4 * size, param_attr=param_attr,
+                bias_attr=bias_attr if bias_attr is not None else None)
+    h = helper.create_variable_for_type_inference(dtype=x_t.dtype)
+    c = helper.create_variable_for_type_inference(dtype=x_t.dtype)
+    helper.append_op("lstm_unit",
+                     inputs={"X": [fc_out.name], "C_prev": [cell_t_prev.name]},
+                     outputs={"H": [h.name], "C": [c.name]},
+                     attrs={"forget_bias": float(forget_bias)})
+    return h, c
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=False, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None):
+    """LSTM with recurrent projection (reference nn.py dynamic_lstmp).
+    `input`: [B, T, 4*hidden] x-projections, as for dynamic_lstm."""
+    helper = LayerHelper("lstmp", **locals())
+    hidden_size = size // 4
+    weight = helper.create_parameter(param_attr,
+                                     [proj_size, 4 * hidden_size], dtype)
+    proj_weight = helper.create_parameter(param_attr,
+                                          [hidden_size, proj_size], dtype)
+    bias = helper.create_parameter(helper.bias_attr, [1, 4 * hidden_size],
+                                   dtype, is_bias=True) \
+        if bias_attr is not False else None
+    proj = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input.name], "Weight": [weight.name],
+              "ProjWeight": [proj_weight.name]}
+    if bias is not None:
+        inputs["Bias"] = [bias.name]
+    seq = helper.ensure_seqlen_var(input)
+    if seq is not None:
+        inputs["SeqLen"] = [seq.name]
+    helper.append_op("lstmp", inputs=inputs,
+                     outputs={"Projection": [proj.name], "Cell": [cell.name]},
+                     attrs={"use_peepholes": use_peepholes,
+                            "is_reverse": is_reverse,
+                            "gate_activation": gate_activation,
+                            "cell_activation": cell_activation,
+                            "candidate_activation": candidate_activation,
+                            "proj_activation": proj_activation})
+    proj.lod_level = cell.lod_level = input.lod_level
+    return proj, cell
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Persistable int64 counter bumped once per executor run (reference
+    nn.py autoincreased_step_counter, used by learning-rate schedulers)."""
+    helper = LayerHelper("global_step_counter")
+    name = counter_name or "@STEP_COUNTER@"
+    blk = helper.main_program.global_block()
+    if name in blk.vars:
+        # idempotent (reference guards with is_new_var): a second caller
+        # shares the counter instead of double-stepping it
+        return blk.vars[name]
+    counter = helper.create_global_variable(
+        name=name, shape=[1], dtype="int64", persistable=True)
+    helper.set_variable_initializer(counter,
+                                    init.ConstantInitializer(begin - step))
+    helper.append_op("increment", inputs={"X": [counter.name]},
+                     outputs={"Out": [counter.name]},
+                     attrs={"step": float(step)})
+    counter.stop_gradient = True
+    return counter
+
+
+def beam_search(pre_ids, pre_scores, probs, beam_size, end_id, name=None,
+                finished=None):
+    """Static-shape beam expansion (reference nn.py beam_search:2657; the
+    reference works on LoD beams, this build on dense [B, beam] state —
+    same selection semantics, TPU-static shapes). `probs` are log-probs
+    [B, beam, V]; returns (selected_ids, parents, new_scores, new_finished).
+    See models/machine_translation.py for the full decode loop."""
+    helper = LayerHelper("beam_search", name=name)
+    if finished is None:
+        raise ValueError("pass the running `finished` [B, beam] bool var")
+    outs = {k: [helper.create_variable_for_type_inference(dtype=d).name]
+            for k, d in (("Ids", "int32"), ("Parents", "int32"),
+                         ("AccScoresOut", probs.dtype),
+                         ("FinishedOut", "bool"))}
+    helper.append_op("beam_search_step",
+                     inputs={"LogProbs": [probs.name],
+                             "AccScores": [pre_scores.name],
+                             "Finished": [finished.name]},
+                     outputs=outs,
+                     attrs={"beam_size": int(beam_size),
+                            "end_id": int(end_id)})
+    blk = helper.main_program.current_block()
+    return tuple(blk.var(outs[k][0])
+                 for k in ("Ids", "Parents", "AccScoresOut", "FinishedOut"))
+
+
+def beam_search_decode(ids_hist, parents_hist, final_scores, beam_size=None,
+                       end_id=None, name=None):
+    """Backtrack stacked beam selections into ranked sequences (reference
+    nn.py beam_search_decode / beam_search_decode_op.cc). ids_hist /
+    parents_hist: [B, T, beam]; returns (sentence_ids [B, beam, T],
+    sentence_scores [B, beam]) best-first."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    ids = helper.create_variable_for_type_inference(dtype="int32")
+    scores = helper.create_variable_for_type_inference(
+        dtype=final_scores.dtype)
+    helper.append_op("beam_backtrack",
+                     inputs={"Ids": [ids_hist.name],
+                             "Parents": [parents_hist.name],
+                             "AccScores": [final_scores.name]},
+                     outputs={"SentenceIds": [ids.name],
+                              "SentenceScores": [scores.name]})
+    blk = helper.main_program.current_block()
+    return ids, scores

@@ -14,3 +14,4 @@ from . import quantize  # noqa: F401
 from . import beam  # noqa: F401
 from . import loss_extra  # noqa: F401
 from . import pallas_attention  # noqa: F401
+from . import extra_nn  # noqa: F401

@@ -1,0 +1,350 @@
+"""Breadth ops completing the reference nn.py layer surface: 3-D conv/pool,
+image resize, crop, multiplex, roi_pool, label_smooth, metric ops.
+
+Capability parity references: conv3d_op.cc, conv3d_transpose (conv_transpose
+_op.cc), pool3d (pool_op.cc), bilinear_interp_op.cc, crop_op.cc,
+random_crop_op.cc, multiplex_op.cc, roi_pool_op.cc, label_smooth_op.cc,
+rank_loss_op.cc, mean_iou_op.cc, ctc_align_op.cc (greedy decode),
+chunk_eval_op.cc, lod_reset_op.cc.
+
+TPU-native: everything is expressed in lax/jnp so XLA maps the convs onto
+the MXU and fuses the rest; roi_pool vmaps a gather-based pooling over the
+ROI list instead of the reference's per-ROI CUDA kernel loop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.registry import register_op
+
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+@register_op("conv3d", propagate_seqlen=False)
+def _conv3d(ctx, Input, Filter, Bias=None):
+    """NCDHW conv (reference conv3d registration in conv_op.cc)."""
+    s = _triple(ctx.attr("strides", [1, 1, 1]))
+    p = _triple(ctx.attr("paddings", [0, 0, 0]))
+    d = _triple(ctx.attr("dilations", [1, 1, 1]))
+    out = lax.conv_general_dilated(
+        Input, Filter, window_strides=s,
+        padding=[(p[0], p[0]), (p[1], p[1]), (p[2], p[2])],
+        rhs_dilation=d,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=ctx.attr("groups", 1) or 1,
+    )
+    if Bias is not None:
+        out = out + Bias.reshape((1, -1, 1, 1, 1))
+    return {"Output": out}
+
+
+@register_op("conv3d_transpose", propagate_seqlen=False)
+def _conv3d_transpose(ctx, Input, Filter, Bias=None):
+    """Gradient-of-conv3d as a forward op; Filter [in_c, out_c, D, H, W]
+    (same construction as the 2-D transpose rule in nn.py)."""
+    s = _triple(ctx.attr("strides", [1, 1, 1]))
+    p = _triple(ctx.attr("paddings", [0, 0, 0]))
+    d = _triple(ctx.attr("dilations", [1, 1, 1]))
+    k_eff = [d[i] * (Filter.shape[2 + i] - 1) + 1 for i in range(3)]
+    out = lax.conv_general_dilated(
+        Input, jnp.flip(Filter, axis=(2, 3, 4)),
+        window_strides=(1, 1, 1),
+        padding=[(k_eff[i] - 1 - p[i], k_eff[i] - 1 - p[i]) for i in range(3)],
+        lhs_dilation=s, rhs_dilation=d,
+        dimension_numbers=("NCDHW", "IODHW", "NCDHW"),
+    )
+    if Bias is not None:
+        out = out + Bias.reshape((1, -1, 1, 1, 1))
+    return {"Output": out}
+
+
+@register_op("pool3d", propagate_seqlen=False)
+def _pool3d(ctx, X):
+    ptype = ctx.attr("pooling_type", "max")
+    k = _triple(ctx.attr("ksize", [2, 2, 2]))
+    s = _triple(ctx.attr("strides", [1, 1, 1]))
+    p = _triple(ctx.attr("paddings", [0, 0, 0]))
+    if ctx.attr("global_pooling", False):
+        red = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": red(X, axis=(2, 3, 4), keepdims=True)}
+    window = (1, 1) + k
+    strides = (1, 1) + s
+    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]), (p[2], p[2]))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(X.dtype, jnp.floating) \
+            else jnp.iinfo(X.dtype).min
+        return {"Out": lax.reduce_window(X, init, lax.max, window,
+                                         strides, pads)}
+    ssum = lax.reduce_window(X, 0.0, lax.add, window, strides, pads)
+    if ctx.attr("exclusive", True):
+        cnt = lax.reduce_window(jnp.ones_like(X), 0.0, lax.add, window,
+                                strides, pads)
+    else:
+        cnt = float(np.prod(k))
+    return {"Out": ssum / cnt}
+
+
+@register_op("bilinear_interp", propagate_seqlen=False)
+def _bilinear_interp(ctx, X, OutSize=None):
+    """NCHW resize (reference bilinear_interp_op.cc). Static out shape from
+    attrs (out_h/out_w or scale); OutSize tensors are unsupported under
+    XLA's static-shape model — pass attrs instead."""
+    if OutSize is not None:
+        raise NotImplementedError(
+            "dynamic OutSize breaks XLA static shapes; pass out_h/out_w attrs")
+    n, c, h, w = X.shape
+    scale = ctx.attr("scale", 0.0) or 0.0
+    oh = ctx.attr("out_h", 0) or int(h * scale)
+    ow = ctx.attr("out_w", 0) or int(w * scale)
+    method = ctx.attr("interp_method", "bilinear")
+    method = {"bilinear": "linear", "nearest": "nearest"}.get(method, method)
+    out = jax.image.resize(X, (n, c, oh, ow), method=method)
+    return {"Out": out.astype(X.dtype)}
+
+
+@register_op("crop", propagate_seqlen=False)
+def _crop(ctx, X, Y=None, Offsets=None):
+    """Static crop (reference crop_op.cc): shape from attr or Y's shape."""
+    shape = ctx.attr("shape") or (list(Y.shape) if Y is not None else None)
+    offsets = ctx.attr("offsets") or [0] * X.ndim
+    if Offsets is not None:
+        raise NotImplementedError("tensor Offsets: pass the offsets attr")
+    return {"Out": lax.slice(X, [int(o) for o in offsets],
+                             [int(o) + int(s) for o, s in zip(offsets, shape)])}
+
+
+@register_op("random_crop", needs_rng=True, propagate_seqlen=False)
+def _random_crop(ctx, X):
+    """Random spatial crop to attr `shape` (trailing dims, reference
+    random_crop_op.cc). Offsets drawn per step from the functional PRNG."""
+    shape = [int(s) for s in ctx.attr("shape")]
+    lead = X.ndim - len(shape)
+    maxs = [X.shape[lead + i] - shape[i] for i in range(len(shape))]
+    keys = jax.random.split(ctx.key, len(shape))
+    starts = [jnp.zeros((), jnp.int32)] * lead + [
+        jax.random.randint(keys[i], (), 0, maxs[i] + 1)
+        for i in range(len(shape))]
+    sizes = list(X.shape[:lead]) + shape
+    return {"Out": lax.dynamic_slice(X, starts, sizes)}
+
+
+@register_op("label_smooth", propagate_seqlen=False)
+def _label_smooth(ctx, X, PriorDist=None):
+    eps = ctx.attr("epsilon", 0.1)
+    k = X.shape[-1]
+    prior = PriorDist if PriorDist is not None else 1.0 / k
+    return {"Out": (1.0 - eps) * X + eps * prior}
+
+
+@register_op("multiplex", propagate_seqlen=False)
+def _multiplex(ctx, X, Ids):
+    """Row-wise select among candidate tensors (reference multiplex_op.cc):
+    out[i] = X[Ids[i]][i]."""
+    stacked = jnp.stack(X if isinstance(X, list) else [X], axis=0)  # [K,B,..]
+    ids = Ids.reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(stacked.shape[1])
+    return {"Out": stacked[ids, rows]}
+
+
+@register_op("mean_iou", propagate_seqlen=False)
+def _mean_iou(ctx, Predictions, Labels):
+    """Mean intersection-over-union over classes (reference mean_iou_op.cc).
+    Returns per-image-batch mean IoU plus the wrong/correct count vectors."""
+    n = ctx.attr("num_classes")
+    pred = Predictions.reshape(-1).astype(jnp.int32)
+    lab = Labels.reshape(-1).astype(jnp.int32)
+    onehot_p = jax.nn.one_hot(pred, n, dtype=jnp.float32)
+    onehot_l = jax.nn.one_hot(lab, n, dtype=jnp.float32)
+    inter = (onehot_p * onehot_l).sum(0)            # diag of confusion
+    union = onehot_p.sum(0) + onehot_l.sum(0) - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1e-9), 0.0)
+    miou = iou.sum() / jnp.maximum(valid.sum(), 1)
+    return {"OutMeanIou": miou.astype(jnp.float32),
+            "OutWrong": (onehot_l.sum(0) - inter).astype(jnp.int32),
+            "OutCorrect": inter.astype(jnp.int32)}
+
+
+@register_op("roi_pool", propagate_seqlen=False)
+def _roi_pool(ctx, X, ROIs, RoisLod=None):
+    """Max-pool each ROI to a fixed grid (reference roi_pool_op.cc).
+
+    ROIs: [N, 5] rows (batch_idx, x1, y1, x2, y2) in input-image
+    coordinates. The reference loops ROIs in a CUDA kernel; here a vmap
+    over ROIs computes each output bin as a masked max over the feature
+    map — O(HW) per bin but static-shaped and fusible.
+    """
+    pooled_h = ctx.attr("pooled_height", 1)
+    pooled_w = ctx.attr("pooled_width", 1)
+    scale = ctx.attr("spatial_scale", 1.0)
+    N, C, H, W = X.shape
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = jnp.round(roi[1] * scale), jnp.round(roi[2] * scale), \
+            jnp.round(roi[3] * scale), jnp.round(roi[4] * scale)
+        feat = X[b]                              # [C, H, W]
+        rh = jnp.maximum(y2 - y1 + 1, 1.0) / pooled_h
+        rw = jnp.maximum(x2 - x1 + 1, 1.0) / pooled_w
+        def bin_val(ph, pw):
+            hs = jnp.floor(y1 + ph * rh)
+            he = jnp.ceil(y1 + (ph + 1) * rh)
+            ws_ = jnp.floor(x1 + pw * rw)
+            we = jnp.ceil(x1 + (pw + 1) * rw)
+            m = ((ys[:, None] >= hs) & (ys[:, None] < he)
+                 & (xs[None, :] >= ws_) & (xs[None, :] < we))
+            masked = jnp.where(m[None], feat, -jnp.inf)
+            v = masked.max(axis=(1, 2))
+            return jnp.where(jnp.isfinite(v), v, 0.0)
+        grid = jnp.stack([jnp.stack([bin_val(ph, pw)
+                                     for pw in range(pooled_w)], -1)
+                          for ph in range(pooled_h)], -2)
+        return grid                               # [C, ph, pw]
+
+    out = jax.vmap(one_roi)(ROIs.astype(jnp.float32))
+    return {"Out": out.astype(X.dtype)}
+
+
+@register_op("ctc_greedy_decoder", propagate_seqlen=True)
+def _ctc_greedy_decoder(ctx, X, SeqLen=None):
+    """Greedy CTC decode (reference ctc_align_op.cc semantics): argmax per
+    frame, merge repeats, drop blanks. Output is a padded [B, T] id tensor
+    plus decoded lengths via the @SEQLEN companion (the reference emits a
+    LoD tensor)."""
+    blank = ctx.attr("blank", 0)
+    ids = jnp.argmax(X, axis=-1).astype(jnp.int32)       # [B, T]
+    B, T = ids.shape
+    seqlen = SeqLen if SeqLen is not None else jnp.full((B,), T, jnp.int32)
+    valid = jnp.arange(T)[None, :] < seqlen[:, None]
+    prev = jnp.concatenate([jnp.full((B, 1), -1, jnp.int32), ids[:, :-1]], 1)
+    keep = valid & (ids != blank) & (ids != prev)
+    # stable left-compaction: position of each kept token in the output
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    out = jnp.full((B, T), blank, jnp.int32)
+    bidx = jnp.repeat(jnp.arange(B), T).reshape(B, T)
+    out = out.at[bidx, jnp.where(keep, pos, T - 1)].set(
+        jnp.where(keep, ids, blank), mode="drop")
+    lens = keep.sum(axis=1).astype(jnp.int32)
+    # re-blank any tail slot that a dropped write left dirty
+    out = jnp.where(jnp.arange(T)[None, :] < lens[:, None], out, blank)
+    return {"Out": out, "OutLen": lens}
+
+
+@register_op("lod_reset", propagate_seqlen=False)
+def _lod_reset(ctx, X, Y=None):
+    """Replace X's sequence-length companion (reference lod_reset_op.cc).
+    Y (or attr target_lod, offsets-style) provides the new lengths."""
+    if Y is not None:
+        lens = Y.astype(jnp.int32)
+    else:
+        lod = ctx.attr("target_lod")
+        lens = jnp.asarray(np.diff(np.asarray(lod)), jnp.int32)
+    if ctx.env is not None and ctx.op is not None:
+        from ..core.ir import SEQLEN_SUFFIX
+        for out_name in ctx.op.output("Out"):
+            ctx.env[out_name + SEQLEN_SUFFIX] = lens
+    return {"Out": X}
+
+
+def _chunk_marks(tags, types, valid, scheme):
+    """Exact chunk (begin, last) position masks per stream.
+
+    A position is in a chunk iff its type >= 0 (B/I/E tags all belong to a
+    chunk in these schemes). `begin` marks chunk starts, `last` marks chunk
+    ends; a chunk is the [begin..last] run. Everything is computed from the
+    local neighborhood, so the masks are exact (no end approximation)."""
+    in_chunk = (types >= 0) & valid
+    prev_in = jnp.concatenate([jnp.zeros_like(in_chunk[:, :1]),
+                               in_chunk[:, :-1]], 1)
+    prev_ty = jnp.concatenate([jnp.full_like(types[:, :1], -1),
+                               types[:, :-1]], 1)
+    prev_tag = jnp.concatenate([jnp.full_like(tags[:, :1], -1),
+                                tags[:, :-1]], 1)
+    if scheme == "IOB":      # tag 0=B, 1=I
+        begin = in_chunk & ((tags == 0) | ~prev_in | (prev_ty != types))
+    elif scheme == "IOE":    # tag 0=I, 1=E: E terminates a chunk
+        begin = in_chunk & (~prev_in | (prev_ty != types) | (prev_tag == 1))
+    elif scheme == "plain":
+        begin = in_chunk & (~prev_in | (prev_ty != types))
+    else:
+        raise NotImplementedError(f"chunk scheme {scheme!r}")
+    nxt_begin = jnp.concatenate([begin[:, 1:],
+                                 jnp.zeros_like(begin[:, :1])], 1)
+    nxt_in = jnp.concatenate([in_chunk[:, 1:],
+                              jnp.zeros_like(in_chunk[:, :1])], 1)
+    last = in_chunk & (nxt_begin | ~nxt_in)
+    if scheme == "IOE":
+        last = in_chunk & ((tags == 1) | nxt_begin | ~nxt_in)
+    return begin, last
+
+
+@register_op("chunk_eval", propagate_seqlen=False)
+def _chunk_eval(ctx, X, Label, SeqLen=None):
+    """Chunk precision/recall/F1 for NER-style tagging (reference
+    chunk_eval_op.cc). A predicted chunk is correct iff a label chunk has
+    the SAME begin, SAME end and SAME type — matched exactly via each
+    stream's begin index at every chunk-last position."""
+    num_types = ctx.attr("num_chunk_types")
+    scheme = ctx.attr("chunk_scheme", "IOB")
+    tag_num = {"IOB": 2, "IOE": 2, "plain": 1}[scheme]
+    exclude = ctx.attr("excluded_chunk_types", []) or []
+
+    def split(x):
+        x = x.reshape(x.shape[0], -1).astype(jnp.int32)
+        types = jnp.where(x >= 0, x // tag_num, -1)
+        tags = jnp.where(x >= 0, x % tag_num, -1)
+        oob = types >= num_types          # the "O"/outside tag
+        return jnp.where(oob, -1, types), jnp.where(oob, -1, tags)
+
+    def mask_excluded(types):
+        m = jnp.ones_like(types, bool)
+        for e in exclude:
+            m &= types != e
+        return m
+
+    inf_ty, inf_tag = split(X)
+    lab_ty, lab_tag = split(Label)
+    B, T = inf_ty.shape
+    seqlen = SeqLen if SeqLen is not None else jnp.full((B,), T, jnp.int32)
+    valid = jnp.arange(T)[None, :] < seqlen[:, None]
+
+    inf_b, inf_l = _chunk_marks(inf_tag, inf_ty, valid, scheme)
+    lab_b, lab_l = _chunk_marks(lab_tag, lab_ty, valid, scheme)
+    inf_b &= mask_excluded(inf_ty)
+    lab_b &= mask_excluded(lab_ty)
+    inf_l &= mask_excluded(inf_ty)
+    lab_l &= mask_excluded(lab_ty)
+
+    # begin-index carried to every position: begins are strictly increasing
+    # within a row, so a running max of (idx where begin else -1) gives the
+    # begin of the chunk containing each in-chunk position exactly
+    idx = jnp.arange(T, dtype=jnp.int32)[None, :]
+    inf_cbi = jax.lax.cummax(jnp.where(inf_b, idx, -1), axis=1)
+    lab_cbi = jax.lax.cummax(jnp.where(lab_b, idx, -1), axis=1)
+
+    # chunk equality at shared last positions: same begin AND same type
+    correct = (inf_l & lab_l & (inf_cbi == lab_cbi) & (inf_cbi >= 0)
+               & (inf_ty == lab_ty))
+
+    n_inf = inf_b.sum().astype(jnp.float32)
+    n_lab = lab_b.sum().astype(jnp.float32)
+    n_cor = correct.sum().astype(jnp.float32)
+    precision = jnp.where(n_inf > 0, n_cor / jnp.maximum(n_inf, 1), 0.0)
+    recall = jnp.where(n_lab > 0, n_cor / jnp.maximum(n_lab, 1), 0.0)
+    f1 = jnp.where(n_cor > 0,
+                   2 * precision * recall / jnp.maximum(precision + recall,
+                                                        1e-9), 0.0)
+    return {"NumInferChunks": inf_b.sum().astype(jnp.int32),
+            "NumLabelChunks": lab_b.sum().astype(jnp.int32),
+            "NumCorrectChunks": correct.sum().astype(jnp.int32),
+            "Precision": precision, "Recall": recall, "F1-Score": f1}

@@ -152,3 +152,54 @@ def _gru_unit(ctx, Input, HiddenPrev, Weight, Bias=None):
     c = cand_act(x[:, 2 * H:] + (r * HiddenPrev) @ W_c)
     h = (1.0 - u) * HiddenPrev + u * c
     return {"Hidden": h, "ResetHiddenPrev": r * HiddenPrev, "Gate": jnp.concatenate([u, r, c], -1)}
+
+
+@register_op("lstmp", propagate_seqlen=True)
+def _lstmp(ctx, Input, Weight, ProjWeight, Bias=None, H0=None, C0=None,
+           SeqLen=None):
+    """LSTM with recurrent projection (reference lstmp_op.cc): the gate
+    recurrence consumes the PROJECTED state r = proj_act(h @ ProjWeight),
+    shrinking the recurrent matmul from [H,4H] to [P,4H]. Input: [B,T,4H]
+    x-projections; Weight: [P, 4H]; ProjWeight: [H, P]."""
+    gate_act = _ACTS[ctx.attr("gate_activation", "sigmoid")]
+    cell_act = _ACTS[ctx.attr("cell_activation", "tanh")]
+    cand_act = _ACTS[ctx.attr("candidate_activation", "tanh")]
+    proj_act = _ACTS[ctx.attr("proj_activation", "tanh")]
+    if ctx.attr("use_peepholes", False):
+        raise NotImplementedError("peephole LSTMP not supported on TPU path yet")
+    B, T, H4 = Input.shape
+    H = H4 // 4
+    P = ProjWeight.shape[1]
+    x = Input
+    seqlen = SeqLen if SeqLen is not None else jnp.full((B,), T, jnp.int32)
+    if ctx.attr("is_reverse", False):
+        x = _reverse_padded(x, seqlen)
+    if Bias is not None:
+        x = x + Bias.reshape(1, 1, H4)
+    r0 = H0 if H0 is not None else jnp.zeros((B, P), Input.dtype)
+    c0 = C0 if C0 is not None else jnp.zeros((B, H), Input.dtype)
+    mask = (jnp.arange(T)[None, :] < seqlen.reshape(-1, 1)).astype(Input.dtype)
+
+    xt_seq = jnp.swapaxes(x, 0, 1)
+    m_seq = jnp.swapaxes(mask, 0, 1)[..., None]
+
+    def step(carry, inp):
+        r, c = carry
+        xt, m = inp
+        gates = xt + r @ Weight
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = gate_act(i), gate_act(f), gate_act(o)
+        c_new = f * c + i * cand_act(g)
+        h_new = o * cell_act(c_new)
+        r_new = proj_act(h_new @ ProjWeight)
+        c_keep = m * c_new + (1.0 - m) * c
+        r_keep = m * r_new + (1.0 - m) * r
+        return (r_keep, c_keep), (r_new * m, c_new * m)
+
+    (_, _), (rs, cs) = lax.scan(step, (r0, c0), (xt_seq, m_seq))
+    proj = jnp.swapaxes(rs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    if ctx.attr("is_reverse", False):
+        proj = _reverse_padded(proj, seqlen)
+        cell = _reverse_padded(cell, seqlen)
+    return {"Projection": proj, "Cell": cell}

@@ -1,0 +1,278 @@
+"""Breadth layers closing the reference nn.py surface gap (#63): 3-D
+conv/pool, image resize, crop, multiplex, roi_pool, metric ops, lstmp,
+beam wrappers, step counter (reference: python/paddle/fluid/layers/nn.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(fetches, feed, prog=None):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return [np.asarray(v) for v in
+            exe.run(prog or fluid.default_main_program(), feed=feed,
+                    fetch_list=fetches)]
+
+
+def test_conv3d_pool3d_shapes_and_grads():
+    x = layers.data(name="x", shape=[-1, 2, 8, 8, 8], dtype="float32",
+                    append_batch_size=False)
+    c = layers.conv3d(input=x, num_filters=4, filter_size=3, padding=1)
+    p = layers.pool3d(input=c, pool_size=2, pool_stride=2)
+    loss = layers.mean(p)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    out, pv = _run([loss, p], {"x": np.random.randn(2, 2, 8, 8, 8)
+                               .astype(np.float32)})
+    assert pv.shape == (2, 4, 4, 4, 4)
+    assert np.isfinite(out).all()
+
+
+def test_conv3d_transpose_shape():
+    x = layers.data(name="x", shape=[-1, 3, 4, 4, 4], dtype="float32",
+                    append_batch_size=False)
+    y = layers.conv3d_transpose(input=x, num_filters=2, filter_size=4,
+                                stride=2, padding=1)
+    out, = _run([y], {"x": np.random.randn(1, 3, 4, 4, 4).astype(np.float32)})
+    assert out.shape == (1, 2, 8, 8, 8)   # (4-1)*2 + 4 - 2*1
+
+
+def test_image_resize_bilinear_matches_jax():
+    import jax
+    x = layers.data(name="x", shape=[-1, 1, 4, 4], dtype="float32",
+                    append_batch_size=False)
+    y = layers.resize_bilinear(x, out_shape=[8, 8])
+    x2 = layers.data(name="x2", shape=[-1, 1, 4, 8], dtype="float32",
+                     append_batch_size=False)
+    y2 = layers.image_resize_short(x2, 8)
+    xs = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out, out2 = _run([y, y2], {"x": xs,
+                               "x2": np.zeros((1, 1, 4, 8), np.float32)})
+    ref = np.asarray(jax.image.resize(xs, (1, 1, 8, 8), "linear"))
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    assert out2.shape == (1, 1, 8, 16)  # short-side resize keeps aspect
+
+
+def test_crop_and_random_crop():
+    x = layers.data(name="x", shape=[-1, 3, 8, 8], dtype="float32",
+                    append_batch_size=False)
+    c = layers.crop(x, shape=[1, 3, 4, 4], offsets=[0, 0, 2, 2])
+    rc = layers.random_crop(x, shape=[5, 5])
+    xs = np.random.randn(1, 3, 8, 8).astype(np.float32)
+    cv, rv = _run([c, rc], {"x": xs})
+    np.testing.assert_array_equal(cv, xs[:, :, 2:6, 2:6])
+    assert rv.shape == (1, 3, 5, 5)
+    # the random window is a contiguous sub-block of x
+    found = any(np.array_equal(rv[0, 0], xs[0, 0, i:i + 5, j:j + 5])
+                for i in range(4) for j in range(4))
+    assert found
+
+
+def test_label_smooth_and_dice_loss():
+    lab = layers.data(name="l", shape=[-1, 4], dtype="float32",
+                      append_batch_size=False)
+    sm = layers.label_smooth(lab, epsilon=0.2)
+    pred = layers.data(name="p", shape=[-1, 4], dtype="float32",
+                       append_batch_size=False)
+    dl = layers.dice_loss(pred, lab)
+    one_hot = np.eye(4, dtype=np.float32)[[1, 3]]
+    sv, dv = _run([sm, dl], {"l": one_hot, "p": one_hot})
+    np.testing.assert_allclose(sv, 0.8 * one_hot + 0.05, rtol=1e-6)
+    assert dv.item() == pytest.approx(0.0, abs=1e-4)  # perfect overlap
+
+
+def test_multiplex_and_rank_loss():
+    a = layers.data(name="a", shape=[-1, 3], dtype="float32",
+                    append_batch_size=False)
+    b = layers.data(name="b", shape=[-1, 3], dtype="float32",
+                    append_batch_size=False)
+    idx = layers.data(name="i", shape=[-1, 1], dtype="int32",
+                      append_batch_size=False)
+    m = layers.multiplex([a, b], idx)
+    av = np.zeros((4, 3), np.float32)
+    bv = np.ones((4, 3), np.float32)
+    iv = np.array([[0], [1], [1], [0]], np.int32)
+    lab = layers.data(name="lab", shape=[-1, 1], dtype="float32",
+                      append_batch_size=False)
+    rl = layers.rank_loss(lab, layers.sigmoid(a), layers.sigmoid(b))
+    mv, rv = _run([m, rl], {"a": av, "b": bv, "i": iv,
+                            "lab": np.ones((4, 1), np.float32)})
+    np.testing.assert_array_equal(mv[:, 0], [0, 1, 1, 0])
+    assert rv.shape[0] == 4 and np.isfinite(rv).all()
+
+
+def test_mean_iou():
+    p = layers.data(name="p", shape=[-1, 4], dtype="int32",
+                    append_batch_size=False)
+    l = layers.data(name="l", shape=[-1, 4], dtype="int32",
+                    append_batch_size=False)
+    miou, wrong, correct = layers.mean_iou(p, l, num_classes=3)
+    pv = np.array([[0, 0, 1, 2]], np.int32)
+    lv = np.array([[0, 1, 1, 2]], np.int32)
+    mv, wv, cv = _run([miou, wrong, correct], {"p": pv, "l": lv})
+    # class0: i1/u2, class1: i1/u2, class2: i1/u1 -> mean = (0.5+0.5+1)/3
+    assert mv.item() == pytest.approx(2 / 3, rel=1e-5)
+
+
+def test_roi_pool():
+    x = layers.data(name="x", shape=[-1, 1, 4, 4], dtype="float32",
+                    append_batch_size=False)
+    rois = layers.data(name="r", shape=[-1, 5], dtype="float32",
+                       append_batch_size=False)
+    rp = layers.roi_pool(x, rois, pooled_height=2, pooled_width=2,
+                         spatial_scale=1.0)
+    xs = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rv = np.array([[0, 0, 0, 3, 3]], np.float32)  # whole image
+    out, = _run([rp], {"x": xs, "r": rv})
+    # 2x2 max pool of the 4x4: quadrant maxima
+    np.testing.assert_array_equal(out[0, 0], [[5, 7], [13, 15]])
+
+
+def test_ctc_greedy_decoder():
+    x = layers.data(name="x", shape=[-1, 6, 4], dtype="float32",
+                    append_batch_size=False)
+    ids, lens = layers.ctc_greedy_decoder(x, blank=0)
+    # frames argmax: 1 1 0 2 2 3 -> merge repeats, drop blank: 1 2 3
+    logits = np.full((1, 6, 4), -5.0, np.float32)
+    for t, k in enumerate([1, 1, 0, 2, 2, 3]):
+        logits[0, t, k] = 5.0
+    iv, lv = _run([ids, lens], {"x": logits})
+    assert lv[0] == 3
+    np.testing.assert_array_equal(iv[0, :3], [1, 2, 3])
+    assert np.all(iv[0, 3:] == 0)
+
+
+def test_chunk_eval_iob():
+    # IOB, 2 types: tags = type*2 + {0:B, 1:I}; outside tag = 4
+    inf = layers.data(name="inf", shape=[-1, 6], dtype="int32",
+                      append_batch_size=False)
+    lab = layers.data(name="lab", shape=[-1, 6], dtype="int32",
+                      append_batch_size=False)
+    pr, rc, f1, ni, nl, nc = layers.chunk_eval(
+        inf, lab, chunk_scheme="IOB", num_chunk_types=2)
+    # label:  [B0 I0 O  B1 I1 O ]  -> 2 chunks
+    # infer:  [B0 I0 O  B0 O  O ]  -> 2 chunks, 1 correct (first)
+    lv = np.array([[0, 1, 4, 2, 3, 4]], np.int32)
+    iv = np.array([[0, 1, 4, 0, 4, 4]], np.int32)
+    prv, rcv, f1v, niv, nlv, ncv = _run([pr, rc, f1, ni, nl, nc],
+                                        {"inf": iv, "lab": lv})
+    assert niv == 2 and nlv == 2 and ncv == 1
+    assert prv == pytest.approx(0.5) and rcv == pytest.approx(0.5)
+    assert f1v == pytest.approx(0.5)
+
+
+def test_lod_reset():
+    x = layers.data(name="x", shape=[-1, 4], dtype="float32", lod_level=1,
+                    append_batch_size=False)
+    y = layers.lod_reset(x, target_lod=[0, 2, 4])
+    out = layers.sequence_pool(y, "sum")
+    xv = np.ones((2, 4), np.float32)
+    ov, = _run([out], {"x": (xv, np.array([4, 4]))})
+    assert ov.shape[0] == 2
+
+
+def test_lstm_unit_and_dynamic_lstmp():
+    x = layers.data(name="x", shape=[-1, 6], dtype="float32",
+                    append_batch_size=False)
+    h0 = layers.fill_constant_batch_size_like(x, [-1, 4], "float32", 0.0)
+    c0 = layers.fill_constant_batch_size_like(x, [-1, 4], "float32", 0.0)
+    h, c = layers.lstm_unit(x, h0, c0)
+    seq = layers.data(name="seq", shape=[-1, 5, 16], dtype="float32",
+                      append_batch_size=False)
+    proj, cell = layers.dynamic_lstmp(seq, size=16, proj_size=3)
+    hv, cv, pv = _run([h, c, proj],
+                      {"x": np.random.randn(3, 6).astype(np.float32),
+                       "seq": np.random.randn(2, 5, 16).astype(np.float32)})
+    assert hv.shape == (3, 4) and cv.shape == (3, 4)
+    assert pv.shape == (2, 5, 3)
+    assert np.isfinite(pv).all()
+
+
+def test_autoincreased_step_counter():
+    ctr = layers.autoincreased_step_counter(begin=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    prog = fluid.default_main_program()
+    vals = [int(np.asarray(exe.run(prog, fetch_list=[ctr])[0]))
+            for _ in range(3)]
+    assert vals == [1, 2, 3]
+
+
+def test_beam_search_wrappers():
+    probs = layers.data(name="p", shape=[-1, 2, 5], dtype="float32",
+                        append_batch_size=False)
+    scores0 = layers.data(name="s", shape=[-1, 2], dtype="float32",
+                          append_batch_size=False)
+    fin0 = layers.data(name="f", shape=[-1, 2], dtype="bool",
+                       append_batch_size=False)
+    ids, parents, scores, fin = layers.beam_search(
+        None, scores0, probs, beam_size=2, end_id=0, finished=fin0)
+    lp = np.log(np.array([[[.05, .6, .2, .1, .05],
+                           [.05, .1, .2, .6, .05]]], np.float32))
+    iv, pv2, sv, fv = _run([ids, parents, scores, fin],
+                           {"p": lp, "s": np.zeros((1, 2), np.float32),
+                            "f": np.zeros((1, 2), bool)})
+    assert iv.shape == (1, 2)
+    assert {int(iv[0, 0]), int(iv[0, 1])} <= {1, 3}  # top tokens win
+
+
+def test_chunk_eval_extra_infer_chunk_in_gap():
+    """A perfectly-predicted label chunk stays correct even when the infer
+    stream opens an extra chunk in the gap after it (review regression)."""
+    inf = layers.data(name="inf2", shape=[-1, 2], dtype="int32",
+                      append_batch_size=False)
+    lab = layers.data(name="lab2", shape=[-1, 2], dtype="int32",
+                      append_batch_size=False)
+    pr, rc, f1, ni, nl, nc = layers.chunk_eval(
+        inf, lab, chunk_scheme="IOB", num_chunk_types=2)
+    lv = np.array([[0, 4]], np.int32)   # [B0, O]  -> 1 chunk
+    iv = np.array([[0, 0]], np.int32)   # [B0, B0] -> 2 chunks, 1st correct
+    prv, rcv, f1v, niv, nlv, ncv = _run([pr, rc, f1, ni, nl, nc],
+                                        {"inf2": iv, "lab2": lv})
+    assert (niv, nlv, ncv) == (2, 1, 1)
+    assert rcv == pytest.approx(1.0) and prv == pytest.approx(0.5)
+
+
+def test_step_counter_idempotent():
+    a = layers.autoincreased_step_counter(begin=1)
+    b = layers.autoincreased_step_counter(begin=1)   # same var, no 2nd inc
+    assert a.name == b.name
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    prog = fluid.default_main_program()
+    vals = [int(np.asarray(exe.run(prog, fetch_list=[a])[0]))
+            for _ in range(3)]
+    assert vals == [1, 2, 3]
+
+
+def test_conv_transpose_output_size():
+    x2 = layers.data(name="x2d", shape=[-1, 3, 4, 4], dtype="float32",
+                     append_batch_size=False)
+    y2 = layers.conv2d_transpose(input=x2, num_filters=2,
+                                 output_size=[8, 8], stride=2, padding=1)
+    x3 = layers.data(name="x3d", shape=[-1, 3, 4, 4, 4], dtype="float32",
+                     append_batch_size=False)
+    y3 = layers.conv3d_transpose(input=x3, num_filters=2,
+                                 output_size=[8, 8, 8], stride=2, padding=1)
+    o2, o3 = _run([y2, y3],
+                  {"x2d": np.random.randn(1, 3, 4, 4).astype(np.float32),
+                   "x3d": np.random.randn(1, 3, 4, 4, 4).astype(np.float32)})
+    assert o2.shape == (1, 2, 8, 8)
+    assert o3.shape == (1, 2, 8, 8, 8)
+
+
+def test_dice_loss_per_sample():
+    """Per-sample dice averaged over batch, not a global pool."""
+    pred = layers.data(name="pd", shape=[-1, 4], dtype="float32",
+                       append_batch_size=False)
+    lab = layers.data(name="lb", shape=[-1, 4], dtype="float32",
+                      append_batch_size=False)
+    dl = layers.dice_loss(pred, lab)
+    # sample A perfect tiny mask (dice loss 0); sample B half-overlap mask
+    p = np.array([[1, 0, 0, 0], [1, 1, 1, 1]], np.float32)
+    l = np.array([[1, 0, 0, 0], [1, 1, 0, 0]], np.float32)
+    dv, = _run([dl], {"pd": p, "lb": l})
+    # B: dice = 2*2/(4+2) = 2/3 -> loss 1/3; mean = (0 + 1/3)/2
+    assert dv.item() == pytest.approx(1 / 6, rel=1e-3)

@@ -212,3 +212,25 @@ def test_record_event_survives_exception():
             raise ValueError("boom")
     rows = prof.print_host_events()
     assert any(r[0] == "failing_phase" for r in rows)
+
+
+def test_debugger_pprint_and_graphviz(tmp_path):
+    """reference debugger.py analogs: program pseudo-code + DOT dump."""
+    from paddle_tpu import debugger
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    h = layers.fc(input=x, size=3, act="relu")
+    loss = layers.mean(h)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    prog = fluid.default_main_program()
+    code = debugger.pprint_program_codes(prog)
+    assert "= mul(" in code and "relu" in code
+    assert "_grad" not in code  # backward hidden by default
+    code_bwd = debugger.pprint_program_codes(prog, show_backward=True)
+    assert "_grad" in code_bwd
+    p = str(tmp_path / "g.dot")
+    dot = debugger.draw_block_graphviz(prog.global_block(),
+                                      highlights=[r"mean"], path=p)
+    assert dot.startswith("digraph G {") and 'shape=box' in dot
+    assert open(p).read() == dot
+    assert "fillcolor=red" in dot      # highlighted var
+    assert "fillcolor=lightblue" in dot  # parameter node

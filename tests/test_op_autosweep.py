@@ -471,6 +471,58 @@ SPECS.update({
     "fake_quantize_range_abs_max": Spec(
         inputs={"X": T(3, 4), "InScale": np.array([1.5], np.float32)},
         outs=("Out", "OutScale"), grad=[]),
+    # ---- breadth ops (extra_nn.py) ---------------------------------------
+    "conv3d": Spec(inputs={"Input": T(1, 2, 5, 5, 5),
+                           "Filter": T(3, 2, 3, 3, 3)},
+                   attrs={"strides": [1, 1, 1], "paddings": [1, 1, 1]},
+                   outs=("Output",), rtol=5e-2, atol=5e-3),
+    "conv3d_transpose": Spec(
+        inputs={"Input": T(1, 2, 3, 3, 3), "Filter": T(2, 3, 3, 3, 3)},
+        attrs={"strides": [2, 2, 2], "paddings": [1, 1, 1]},
+        outs=("Output",), rtol=5e-2, atol=5e-3),
+    "pool3d": Spec(inputs={"X": T(1, 2, 4, 4, 4)},
+                   attrs={"pooling_type": "avg", "ksize": [2, 2, 2],
+                          "strides": [2, 2, 2], "paddings": [0, 0, 0]}),
+    "bilinear_interp": Spec(inputs={"X": T(1, 2, 4, 4)},
+                            attrs={"out_h": 8, "out_w": 8}),
+    "crop": Spec(inputs={"X": T(2, 6, 6)},
+                 attrs={"shape": [1, 3, 3], "offsets": [0, 1, 2]}),
+    "random_crop": Spec(inputs={"X": T(2, 3, 6, 6)},
+                        attrs={"shape": [4, 4]}, grad=[],
+                        check=lambda o: o[0].shape == (2, 3, 4, 4)),
+    "label_smooth": Spec(inputs={"X": POS(3, 5)},
+                         attrs={"epsilon": 0.1}),
+    "multiplex": Spec(inputs={"X": [T(4, 3), T(4, 3)],
+                              "Ids": T(4, 1, lo=0, hi=2, dtype="int32")},
+                      grad=[]),
+    "mean_iou": Spec(inputs={"Predictions": T(2, 6, lo=0, hi=3,
+                                              dtype="int32"),
+                             "Labels": T(2, 6, lo=0, hi=3, dtype="int32")},
+                     attrs={"num_classes": 3},
+                     outs=("OutMeanIou",), grad=[]),
+    "roi_pool": Spec(
+        inputs={"X": T(1, 2, 6, 6),
+                "ROIs": np.array([[0, 0, 0, 3, 3], [0, 1, 1, 5, 5]],
+                                 np.float32)},
+        attrs={"pooled_height": 2, "pooled_width": 2,
+               "spatial_scale": 1.0},
+        grad=["X"], rtol=5e-2, atol=5e-3),
+    "ctc_greedy_decoder": Spec(
+        inputs={"X": T(2, 5, 4)}, attrs={"blank": 0},
+        outs=("Out", "OutLen"), grad=[]),
+    "lod_reset": Spec(inputs={"X": T(4, 3),
+                              "Y": np.array([2, 2], np.int32)}),
+    "chunk_eval": Spec(
+        inputs={"X": T(1, 6, lo=0, hi=4, dtype="int32"),
+                "Label": T(1, 6, lo=0, hi=4, dtype="int32")},
+        attrs={"num_chunk_types": 2, "chunk_scheme": "IOB"},
+        outs=("NumInferChunks", "NumLabelChunks", "NumCorrectChunks"),
+        grad=[]),
+    "lstmp": Spec(inputs={"Input": T(2, 4, 12), "Weight": T(2, 12),
+                          "ProjWeight": T(3, 2), "Bias": T(1, 12)},
+                  lod={"Input": np.array([4, 2], np.int32)},
+                  outs=("Projection",), grad=["Weight", "ProjWeight"],
+                  rtol=5e-2, atol=5e-3),
 })
 
 # Waivers: ops whose correct behavior needs surrounding machinery that a
