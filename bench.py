@@ -156,6 +156,74 @@ def bench_transformer(fluid, models, jax, seq_len, batch_size, fused,
     return tok_s, flops / dt
 
 
+def bench_feeder_overlap(fluid, jax, steps=25):
+    """Like-for-like pair: the same conv model stepped from host numpy
+    batches synchronously vs through the double-buffering AsyncFeeder
+    (reference py_reader/double_buffer claim, layers/io.py:449).
+
+    Honesty note: through this dev environment's ~40 MB/s, high-latency
+    tunnel the per-step dispatch variance exceeds the H2D cost, so the
+    reported speedup hovers around 1.0 and mainly proves the feeder
+    drives a real training loop; on a directly-attached TPU host the
+    async path hides the full H2D copy behind the previous step."""
+    from paddle_tpu import layers
+    from paddle_tpu.async_feeder import AsyncFeeder
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        img = layers.data(name="img", shape=[-1, 64, 64, 3],
+                          dtype="float32", append_batch_size=False)
+        lab = layers.data(name="lab", shape=[-1, 1], dtype="int64",
+                          append_batch_size=False)
+        h = layers.conv2d(input=img, num_filters=32, filter_size=3,
+                          padding=1, act="relu", data_format="NHWC")
+        h = layers.pool2d(input=h, pool_size=2, pool_stride=2,
+                          data_format="NHWC")
+        h = layers.conv2d(input=h, num_filters=64, filter_size=3,
+                          padding=1, act="relu", data_format="NHWC")
+        p = layers.fc(input=h, size=10, act="softmax")
+        loss = layers.mean(layers.cross_entropy(input=p, label=lab))
+        fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9) \
+            .minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0), amp=True)
+    exe.run(startup, scope=scope)
+
+    rng = np.random.RandomState(0)
+    host_batches = [{"img": rng.rand(16, 64, 64, 3).astype(np.float32),
+                     "lab": rng.randint(0, 10, (16, 1)).astype(np.int64)}
+                    for _ in range(steps)]
+
+    def run_once(feed_iter):
+        out = None
+        t0 = time.perf_counter()
+        for feed in feed_iter:
+            out = exe.run(main, feed=feed, fetch_list=[loss],
+                          return_numpy=False, scope=scope)
+        _sync(out[0])
+        return time.perf_counter() - t0
+
+    def reader():
+        yield from ([b] for b in host_batches)
+
+    def make_feeder():
+        return AsyncFeeder(lambda b: b[0], reader, capacity=4,
+                           device=exe.place.jax_device())
+
+    # warm up BOTH feed styles: committed device arrays and host numpy
+    # specialize the jit separately (dtype/placement signatures differ)
+    exe.run(main, feed=host_batches[0], fetch_list=[loss],
+            return_numpy=False, scope=scope)
+    for feed in make_feeder():
+        exe.run(main, feed=feed, fetch_list=[loss], return_numpy=False,
+                scope=scope)
+        break
+
+    t_sync = run_once(iter(host_batches))
+    t_async = run_once(iter(make_feeder()))
+    return steps * 16 / t_sync, steps * 16 / t_async
+
+
 def main():
     import jax
     import paddle_tpu as fluid
@@ -179,6 +247,7 @@ def main():
     tok_long_unf, _ = bench_transformer(fluid, models, jax, seq_len=2048,
                                         batch_size=8, fused=False, steps=8,
                                         warmup=3)
+    sync_ips, async_ips = bench_feeder_overlap(fluid, jax)
 
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
@@ -194,6 +263,9 @@ def main():
             "transformer_seq2048_flash_tokens_per_sec": round(tok_long_fus, 0),
             "transformer_seq2048_unfused_tokens_per_sec": round(tok_long_unf, 0),
             "transformer_seq2048_mfu": round(tf2k_fps / peak, 3),
+            "feeder_sync_images_per_sec": round(sync_ips, 1),
+            "feeder_async_images_per_sec": round(async_ips, 1),
+            "feeder_h2d_overlap_speedup": round(async_ips / sync_ips, 2),
         },
     }))
 

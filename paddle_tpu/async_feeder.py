@@ -3,11 +3,13 @@ analog (reference: python/paddle/fluid/layers/io.py:449 `py_reader`,
 operators/reader/create_double_buffer_reader_op.cc,
 reader/lod_tensor_blocking_queue.h).
 
-TPU-native redesign: a background thread pulls batches from a python reader,
-converts via DataFeeder, and pre-transfers them to device (`jax.device_put`),
-keeping a bounded queue full so each training step's H2D copy overlaps the
-previous step's compute — the double-buffer property. No in-graph reader ops
-are needed because feeds enter the jitted step as arguments.
+TPU-native redesign: a background thread pulls batches from a python reader
+and converts them via DataFeeder (host-side work) into a bounded queue; the
+consumer thread issues the `jax.device_put` at yield time — PJRT enqueues
+the copy asynchronously, so it still overlaps the previous step's compute
+(the double-buffer property) without driving the device from two threads.
+No in-graph reader ops are needed because feeds enter the jitted step as
+arguments.
 """
 
 from __future__ import annotations
@@ -37,8 +39,17 @@ class AsyncFeeder:
         self._pad_to = pad_to
 
     def _convert(self, batch) -> Dict:
+        """Host-side conversion only — runs on the producer thread."""
         feed = (self._feeder.feed(batch, pad_to=self._pad_to)
                 if hasattr(self._feeder, "feed") else self._feeder(batch))
+        return feed
+
+    def _place(self, feed) -> Dict:
+        """Device placement at yield time, on the CONSUMER thread: PJRT
+        device_put is an async enqueue, so the copy still overlaps the
+        previous step's compute, while issuing transfers from a second
+        thread is avoided (runtimes — the axon tunnel in particular — may
+        serialize or deadlock on concurrent stream use)."""
         target = self._sharding or self._device
         if target is not None:
             out = {}
@@ -71,10 +82,15 @@ class AsyncFeeder:
             except Exception as e:  # surface reader errors on the consumer
                 err.append(e)
             finally:
-                try:
-                    q.put_nowait(end)
-                except queue.Full:
-                    pass
+                # the end sentinel must be DELIVERED, not best-effort: a
+                # full queue here (consumer slower than producer) would
+                # drop it and hang the consumer after it drains
+                while not stop.is_set():
+                    try:
+                        q.put(end, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
@@ -83,7 +99,7 @@ class AsyncFeeder:
                 item = q.get()
                 if item is end:
                     break
-                yield item
+                yield self._place(item)
         finally:
             # on break/close: release the producer and drop buffered batches
             stop.set()

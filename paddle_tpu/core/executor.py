@@ -49,10 +49,13 @@ class Place:
 
 class CPUPlace(Place):
     def jax_device(self):
-        for d in jax.devices():
+        # local_devices: under multi-controller jax, jax.devices()[0] can
+        # belong to ANOTHER process — computing there would leave this
+        # process holding arrays with no addressable shards
+        for d in jax.local_devices():
             if d.platform == "cpu":
                 return d
-        return jax.devices()[0]
+        return jax.local_devices()[0]
 
     def __repr__(self):
         return "CPUPlace()"
@@ -63,7 +66,7 @@ class TPUPlace(Place):
         self.device_id = device_id
 
     def jax_device(self):
-        devs = jax.devices()
+        devs = jax.local_devices()
         return devs[self.device_id % len(devs)]
 
     def __repr__(self):
@@ -146,14 +149,16 @@ class _CompiledProgram:
     """One lowered+jitted step for a (program version, feed/fetch set)."""
 
     def __init__(self, program: ir.Program, feed_names, fetch_names, scope: Scope,
-                 donate: bool, amp: bool = False, check_nan_inf: bool = False):
+                 donate: bool, amp: bool = False, check_nan_inf: bool = False,
+                 mesh=None):
         self.program = program
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
         self.check_nan_inf = check_nan_inf
         self._nan_meta = []
         block = program.global_block()
-        lowerer = BlockLowerer(program, amp=amp, check_nan_inf=check_nan_inf)
+        lowerer = BlockLowerer(program, amp=amp, check_nan_inf=check_nan_inf,
+                               mesh=mesh)
 
         # Statically determine which scope vars the block reads/writes.
         written: List[str] = []

@@ -29,11 +29,15 @@ class BlockLowerer:
     """Lowers a Block's op list into a pure function over an env dict."""
 
     def __init__(self, program: ir.Program, amp: bool = False,
-                 check_nan_inf: bool = False):
+                 check_nan_inf: bool = False, mesh=None):
         self.program = program
         # bf16 mixed precision for MXU ops (registry.AMP_OPS); params stay
         # fp32, accumulation is fp32 on the MXU.
         self.amp = amp
+        # device mesh when compiling under ParallelExecutor; ops with
+        # mesh-aware lowerings (fused_attention -> ring attention over the
+        # 'sp' axis) read it via ctx.lowerer.mesh
+        self.mesh = mesh
         # reference FLAGS_check_nan_inf (CheckTensorNANOrInf after every op,
         # operator.cc:622-634). XLA programs cannot raise, so each op's
         # float outputs contribute an all-finite flag; the executor checks

@@ -75,3 +75,18 @@ def barrier():
         x = jax.device_put(jnp.zeros(len(jax.devices())),
                            NamedSharding(mesh, PartitionSpec("dp")))
         jax.jit(lambda a: a.sum(), out_shardings=NamedSharding(mesh, PartitionSpec()))(x).block_until_ready()
+
+
+def shard_local_batch(arr, mesh=None, axis="dp"):
+    """Build a GLOBAL batch-sharded array from this host's LOCAL batch —
+    the production multi-host feeding pattern (each trainer reads its own
+    data shard; the reference's trainers likewise each read a file split,
+    trainer.py train_reader slicing). The global batch dim is
+    world_local_sum of the per-host dims."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+    mesh = mesh or global_mesh(axis_names=(axis,))
+    spec = [None] * np.ndim(arr)
+    spec[0] = axis
+    sh = NamedSharding(mesh, PartitionSpec(*spec))
+    return jax.make_array_from_process_local_data(sh, np.asarray(arr))

@@ -1,6 +1,7 @@
 """Layers DSL (reference: python/paddle/fluid/layers/)."""
 
-from .io import data  # noqa: F401
+from .io import (data, py_reader, open_recordio_file,  # noqa: F401
+                 double_buffer, ListenAndServ, Send, Recv)
 from .nn import *  # noqa: F401,F403
 from .tensor import (create_tensor, create_global_var, fill_constant,  # noqa: F401
                      fill_constant_batch_size_like, assign, cast, concat, sums,

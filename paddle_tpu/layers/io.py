@@ -33,3 +33,108 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
     if lod_level > 0:
         helper.ensure_seqlen_var(v)
     return v
+
+
+# ---------------------------------------------------------------------------
+# reader-layer surface (reference io.py exposes the reader stack here; the
+# implementations live in paddle_tpu.reader / paddle_tpu.recordio /
+# paddle_tpu.pserver and are re-surfaced under the reference names)
+# ---------------------------------------------------------------------------
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """Blocking-queue reader + its feed vars (reference io.py:449)."""
+    from ..reader.py_reader import py_reader as _impl
+    return _impl(capacity, shapes, dtypes, lod_levels=lod_levels, name=name,
+                 use_double_buffer=use_double_buffer)
+
+
+def open_recordio_file(filename, shapes, dtypes, lod_levels=None,
+                       pass_num=1, for_parallel=True):
+    """RecordIO-backed reader (reference io.py:320): returns a PyReader
+    whose producer scans the file; records are pickled per-var tuples as
+    written by paddle_tpu.recordio + DataFeeder (see tests/test_data_plane
+    for the end-to-end train-from-recordio cycle)."""
+    import pickle
+    from .. import recordio as rio
+    from ..reader.py_reader import py_reader as _impl
+
+    reader, feed_vars = _impl(capacity=64, shapes=shapes, dtypes=dtypes,
+                              lod_levels=lod_levels)
+
+    def scan():
+        for _ in range(pass_num):
+            batch = []
+            for rec in rio.reader(filename)():
+                batch.append(pickle.loads(rec))
+                if len(batch) == 16:
+                    yield batch
+                    batch = []
+            if batch:
+                yield batch
+
+    reader.decorate_paddle_reader(scan)
+    return reader, feed_vars
+
+
+def double_buffer(reader, place=None, name=None):
+    """Double-buffering decorator (reference io.py:866). PyReader already
+    double-buffers (device pre-placement in its producer design); for plain
+    python readers this wraps them in a buffered prefetch."""
+    from ..reader import decorator as dec
+    if hasattr(reader, "decorate_paddle_reader"):
+        return reader            # PyReader: already double-buffered
+    return dec.buffered(reader, 2)
+
+
+def ListenAndServ(endpoint, inputs=None, fan_in=1, optimizer_mode=True):
+    """Parameter-server serving loop (reference io.py:114). TPU-native: the
+    host ParameterServer runtime (paddle_tpu/pserver/server.py) IS the
+    listen-and-serv op — this shim starts it on `endpoint` and returns the
+    server handle (stop() to shut down). Program-embedded server sub-blocks
+    are retired: see docs/RETIREMENT.md."""
+    from ..pserver import ParameterServer
+    return ParameterServer(endpoint).start()
+
+
+_ps_clients = {}
+
+
+def _ps_client(endpoint):
+    """One cached PSClient (socket + pool) per endpoint — Send/Recv are
+    called per training step; constructing a client per call would leak a
+    socket and a thread pool each step."""
+    from ..pserver import PSClient
+    if endpoint not in _ps_clients:
+        _ps_clients[endpoint] = PSClient([endpoint])
+    return _ps_clients[endpoint]
+
+
+def Send(endpoint, var_names, scope=None, sync=True):
+    """Push variables to a pserver (reference io.py:209 Send). Dense push
+    via the PSClient gRPC-analog protocol."""
+    import numpy as np
+    from ..core.executor import global_scope
+    scope = scope or global_scope()
+    c = _ps_client(endpoint)
+    for n in (var_names if isinstance(var_names, (list, tuple)) else [var_names]):
+        val = scope.find_var(n)
+        if val is None:
+            raise KeyError(f"Send: variable {n!r} not found in scope")
+        # grads are sent under their parameter's name (the reference's
+        # transpiler maps w@GRAD slices onto the pserver-side param block)
+        target = n[:-len("@GRAD")] if n.endswith("@GRAD") else n
+        c.push_grad(endpoint, target, np.asarray(val))
+
+
+def Recv(endpoint, var_names, scope=None, sync=True):
+    """Fetch variables from a pserver (reference io.py:241 Recv)."""
+    from ..core.executor import global_scope
+    scope = scope or global_scope()
+    c = _ps_client(endpoint)
+    out = []
+    for n in (var_names if isinstance(var_names, (list, tuple)) else [var_names]):
+        val = c.get_param(endpoint, n)
+        scope.set_var(n, val)
+        out.append(val)
+    return out

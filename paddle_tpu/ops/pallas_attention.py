@@ -409,6 +409,24 @@ def _fused_attention(ctx, Q, K, V):
     sm_scale = ctx.attr("sm_scale", 1.0 / math.sqrt(Q.shape[-1]))
     causal = ctx.attr("causal", False)
     rate = 0.0 if ctx.attr("is_test", False) else ctx.attr("dropout_rate", 0.0)
+    mesh = getattr(ctx.lowerer, "mesh", None) if ctx.lowerer else None
+    if (mesh is not None and "sp" in mesh.axis_names
+            and mesh.shape["sp"] > 1):
+        # sequence parallelism: the ParallelExecutor shards the seq dim
+        # over 'sp', so attention becomes Ring Attention — K/V shards
+        # rotate over ICI while the online softmax accumulates.
+        if rate:
+            raise NotImplementedError(
+                "attention-weight dropout is not supported under sequence "
+                "parallelism; build the model with dropout_rate=0 (or move "
+                "dropout outside the attention op)")
+        if Q.shape[2] % mesh.shape["sp"] != 0:
+            raise ValueError(
+                f"sequence length {Q.shape[2]} is not divisible by the "
+                f"{mesh.shape['sp']}-way 'sp' mesh axis; pad the sequence "
+                f"or choose an sp that divides it")
+        return {"Out": ring_attention(Q, K, V, mesh, axis="sp",
+                                      causal=causal, sm_scale=sm_scale)}
     seed = jnp.uint32(0)
     if rate and ctx.key is not None:
         seed = jax.random.key_data(ctx.key).reshape(-1)[0]
@@ -472,6 +490,14 @@ def ring_attention(q, k, v, mesh, axis="sp", causal=False, sm_scale=None):
                                         jnp.arange(sp))
         return (acc / jnp.maximum(l, 1e-20)[..., None]).astype(qs.dtype)
 
-    spec = P(None, None, axis, None)
+    # carry the mesh's OTHER axes in the specs too: naming only 'sp' would
+    # make GSPMD all-gather the full batch/head dims into every dp/mp
+    # group and compute attention redundantly across them
+    names = mesh.axis_names
+    b_ax = "dp" if ("dp" in names and q.shape[0] % mesh.shape["dp"] == 0) \
+        else None
+    h_ax = "mp" if ("mp" in names and q.shape[1] % mesh.shape["mp"] == 0) \
+        else None
+    spec = P(b_ax, h_ax, axis, None)
     return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, check_rep=False)(q, k, v)

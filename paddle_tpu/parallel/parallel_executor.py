@@ -79,9 +79,9 @@ class ParallelExecutor:
         self._exec_strategy = exec_strategy or ExecutionStrategy()
         self._loss_name = loss_name
         self._cache: Dict[tuple, _CompiledProgram] = {}
+        self._last_key = None
         self._run_counter = 0
         self._replicated = NamedSharding(self._mesh, PartitionSpec())
-        self._batch_sharded = NamedSharding(self._mesh, PartitionSpec("dp"))
         self._bcast_params()
 
     # reference BCastParamsToDevices (parallel_executor.cc:204): replicate
@@ -92,7 +92,29 @@ class ParallelExecutor:
             val = self._scope.find_var(name)
             if val is None or not hasattr(val, "shape"):
                 continue
-            self._scope.set_var(name, jax.device_put(val, sharding_for(name, val)))
+            self._scope.set_var(name, self._place_global(
+                val, sharding_for(name, val)))
+
+    def _place_global(self, val, sharding):
+        """Place a host-local value under `sharding`. Single-controller:
+        plain device_put. Multi-host: device_put cannot target remote
+        devices, so the global array is assembled from each process's
+        local copy (every host initialized identical params from the same
+        seeded startup program — the reference broadcasts from dev0
+        instead, parallel_executor.cc:204)."""
+        if jax.process_count() == 1:
+            return jax.device_put(val, sharding)
+        if isinstance(val, jax.Array) and not val.is_fully_addressable:
+            # already a world-spanning array (multi-controller jit outputs
+            # are): keep it if the sharding already matches, else localize
+            if val.sharding == sharding:
+                return val
+            val = self._fetch_numpy(val)
+        val = np.asarray(val)
+        idx_map = sharding.addressable_devices_indices_map(val.shape)
+        shards = [jax.device_put(val[idx], d) for d, idx in idx_map.items()]
+        return jax.make_array_from_single_device_arrays(val.shape, sharding,
+                                                        shards)
 
     def _sharding_for_state(self, name, val):
         # 1. Parameter-level annotations (ParamAttr.sharding, e.g. the
@@ -140,26 +162,17 @@ class ParallelExecutor:
 
         fetch_names = [f.name if isinstance(f, ir.Variable) else str(f)
                        for f in fetch_list]
-        block = self._program.global_block()
-        feed_arrays = {}
-        for name, val in feed.items():
-            var = block.vars.get(name)
-            if isinstance(val, (tuple, list)) and len(val) == 2 and var is not None \
-                    and var.lod_level > 0:
-                data, lens = val
-                feed_arrays[name] = self._shard_feed(np.asarray(data), var)
-                feed_arrays[ir.seqlen_var_name(name)] = self._shard_feed(
-                    np.asarray(lens, np.int32), var)
-            else:
-                feed_arrays[name] = self._shard_feed(np.asarray(val), var)
+        feed_arrays = self._convert_feeds(feed)
 
         key = (self._program._uid, self._program._version,
                tuple(sorted(feed_arrays)), tuple(fetch_names))
+        self._last_key = key
         compiled = self._cache.get(key)
         if compiled is None:
             compiled = _CompiledProgram(self._program, sorted(feed_arrays),
                                         fetch_names, self._scope, donate=True,
-                                        amp=self._build_strategy.amp)
+                                        amp=self._build_strategy.amp,
+                                        mesh=self._mesh)
             self._cache[key] = compiled
 
         seed = self._program.random_seed if self._program.random_seed is not None else 0
@@ -167,25 +180,91 @@ class ParallelExecutor:
         self._run_counter += 1
         fetches = compiled.run(self._scope, feed_arrays, prng)
         if return_numpy:
-            fetches = [np.asarray(f) for f in fetches]
+            fetches = [self._fetch_numpy(f) for f in fetches]
         return fetches
 
-    def _shard_feed(self, arr: np.ndarray, var=None):
-        ndev = self.device_count
+    @staticmethod
+    def _fetch_numpy(f):
+        """Multi-host fetch: a global array spanning remote devices cannot
+        be np.asarray'd directly — read the local copy when replicated,
+        allgather otherwise (every process calls fetch symmetrically, so
+        the collective is safe)."""
+        if isinstance(f, jax.Array) and not f.is_fully_addressable:
+            if f.sharding.is_fully_replicated:
+                return np.asarray(f.addressable_shards[0].data)
+            from jax.experimental import multihost_utils
+            return np.asarray(multihost_utils.process_allgather(f,
+                                                                tiled=True))
+        return np.asarray(f)
+
+    def _convert_feeds(self, feed):
+        block = self._program.global_block()
+        feed_arrays = {}
+        for name, val in feed.items():
+            var = block.vars.get(name)
+            if isinstance(val, (tuple, list)) and len(val) == 2 and var is not None \
+                    and var.lod_level > 0:
+                data, lens = val
+                feed_arrays[name] = self._shard_feed(data, var)
+                feed_arrays[ir.seqlen_var_name(name)] = self._shard_feed(
+                    np.asarray(lens, np.int32), var)
+            else:
+                feed_arrays[name] = self._shard_feed(val, var)
+        return feed_arrays
+
+    def lowered_text(self, feed) -> str:
+        """StableHLO text of the step this feed shape ran through — the
+        supported way to inspect what GSPMD emitted (tests/dryrun assert
+        on collective ops here instead of poking privates). Requires a
+        prior run() with the same feed names (and fetch list)."""
+        if not self._cache:
+            raise RuntimeError("lowered_text requires a prior run()")
+        feeds = self._convert_feeds(feed)
+        names = tuple(sorted(feeds))
+        cands = [k for k in self._cache
+                 if k[2] == names and k[1] == self._program._version]
+        if not cands:
+            raise RuntimeError(
+                f"no compiled step matches feed names {sorted(feeds)}; "
+                f"run() with this feed first")
+        # prefer the step the LAST run used (disambiguates fetch lists)
+        key = self._last_key if self._last_key in cands else cands[-1]
+        compiled = self._cache[key]
+        mut = {n: self._scope.find_var(n) for n in compiled.mut_names}
+        const = {n: self._scope.find_var(n) for n in compiled.const_names}
+        return compiled._step.lower({k: feeds[k] for k in sorted(feeds)},
+                                    mut, const, jax.random.key(0)).as_text()
+
+    def _shard_feed(self, arr, var=None):
+        # already-global arrays (dist.shard_local_batch on multi-host, or a
+        # re-fed fetch) pass through untouched
+        if isinstance(arr, jax.Array) and getattr(arr, "sharding", None) is not None \
+                and isinstance(arr.sharding, NamedSharding) \
+                and arr.sharding.mesh == self._mesh:
+            return arr
+        arr = np.asarray(arr)
         if arr.ndim == 0:
-            return jax.device_put(arr, self._replicated)
-        if arr.shape[0] % ndev != 0:
+            return self._place_global(arr, self._replicated)
+        dp = self._mesh.shape.get("dp", 1)  # no 'dp' axis -> replicated dim 0
+        if arr.shape[0] % dp != 0:
             if var is None or var.is_data:
                 # a silently replicated DATA feed would train every device
                 # on the SAME rows — a correctness bug, not a fallback
                 # (reference PE enforces divisibility via data_balance)
                 raise ValueError(
                     f"feed batch dim {arr.shape[0]} is not divisible by the "
-                    f"{ndev}-device data-parallel mesh; pad or drop the tail "
-                    f"batch (reader.batch(..., drop_last=True))")
+                    f"{dp}-way data-parallel mesh axis; pad or drop the "
+                    f"tail batch (reader.batch(..., drop_last=True))")
             # non-data feeds (lr schedules, class weights, ...) have no
             # batch dimension — replicate
-            return jax.device_put(arr, self._replicated)
+            return self._place_global(arr, self._replicated)
         spec = [None] * arr.ndim
-        spec[0] = "dp"
-        return jax.device_put(arr, NamedSharding(self._mesh, PartitionSpec(*spec)))
+        spec[0] = "dp" if "dp" in self._mesh.axis_names else None
+        # sequence parallelism: shard the seq dim of data feeds over 'sp'
+        # so ring attention's Q/K/V shards arrive pre-placed
+        if ("sp" in self._mesh.axis_names and arr.ndim >= 2
+                and var is not None and var.is_data
+                and arr.shape[1] % self._mesh.shape["sp"] == 0):
+            spec[1] = "sp"
+        return self._place_global(arr, NamedSharding(self._mesh,
+                                                     PartitionSpec(*spec)))

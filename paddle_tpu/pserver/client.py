@@ -48,13 +48,37 @@ class PSClient:
                 self._socks[endpoint] = rpc.connect(endpoint)
             return self._socks[endpoint]
 
+    # RPCs safe to replay on a dropped connection: reads and first-wins
+    # initialization. Mutating commands (push_grad, batch_barrier, ...)
+    # are NOT replayed — the drop may have happened after the server
+    # applied the request, and a duplicate grad push double-steps the
+    # param while a duplicate barrier arrival releases it early.
+    _IDEMPOTENT = frozenset({"get_param", "get_params", "prefetch_rows",
+                             "init_param", "init_table"})
+
     def _call(self, endpoint, cmd, **payload):
         with self._lock:
             ep_lock = self._ep_locks.setdefault(endpoint, threading.Lock())
         with ep_lock:  # one in-flight request per connection
-            sock = self._sock(endpoint)
-            rpc.send_msg(sock, (cmd, payload))
-            status, value = rpc.recv_msg(sock)
+            try:
+                sock = self._sock(endpoint)
+                rpc.send_msg(sock, (cmd, payload))
+                status, value = rpc.recv_msg(sock)
+            except (ConnectionError, EOFError, OSError):
+                if cmd not in self._IDEMPOTENT:
+                    raise
+                # transparent one-shot reconnect for idempotent RPCs, as
+                # the reference's gRPC channel re-dials dropped channels
+                with self._lock:
+                    old = self._socks.pop(endpoint, None)
+                if old is not None:
+                    try:
+                        old.close()
+                    except OSError:
+                        pass
+                sock = self._sock(endpoint)
+                rpc.send_msg(sock, (cmd, payload))
+                status, value = rpc.recv_msg(sock)
         if status != "ok":
             raise RuntimeError(f"pserver {endpoint} {cmd}: {value}")
         return value

@@ -283,3 +283,68 @@ def test_py_reader_trains_from_recordio(tmp_path):
         assert len(losses) == 6  # 96 / 16
         epoch_losses.append(np.mean(losses))
     assert epoch_losses[-1] < epoch_losses[0] * 0.5, epoch_losses
+
+
+def test_async_feeder_slow_consumer_terminates():
+    """End-sentinel delivery regression: with the queue still full when the
+    reader finishes, the sentinel must be delivered (blocking), not
+    dropped — a slow consumer previously hung forever after draining."""
+    import time
+    from paddle_tpu.async_feeder import AsyncFeeder
+
+    batches = [{"a": np.full((2, 2), i, np.float32)} for i in range(6)]
+
+    def reader():
+        yield from ([b] for b in batches)
+
+    feeder = AsyncFeeder(lambda b: b[0], reader, capacity=1)
+    seen = []
+    for feed in feeder:          # consumer slower than producer
+        time.sleep(0.05)
+        seen.append(float(feed["a"][0, 0]))
+    assert seen == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+
+
+def test_layers_io_surface():
+    """Reference io.py layer-surface parity: py_reader/open_recordio_file/
+    double_buffer/ListenAndServ/Send/Recv exposed as layers (io.py:114-943)."""
+    import pickle
+    import tempfile
+    from paddle_tpu import recordio as rio
+    from paddle_tpu import layers
+
+    # open_recordio_file: write pickled sample tuples, train-read them back
+    path = tempfile.mktemp(suffix=".recordio")
+    samples = [(np.full((4,), i, np.float32), np.array([i % 2], np.int64))
+               for i in range(8)]
+    rio.write_file(path, (pickle.dumps(s) for s in samples))
+    reader, feed_vars = layers.open_recordio_file(
+        path, shapes=[[-1, 4], [-1, 1]], dtypes=["float32", "int64"])
+    reader.start()
+    feeds = list(iter(reader))
+    reader.reset()
+    assert feeds and set(feeds[0]) == {v.name for v in feed_vars}
+    total = sum(f[feed_vars[0].name].shape[0] for f in feeds)
+    assert total == 8
+
+    # double_buffer over a plain reader is a buffered passthrough
+    db = layers.double_buffer(lambda: iter(range(5)))
+    assert list(db()) == [0, 1, 2, 3, 4]
+
+    # ListenAndServ/Send/Recv round-trip through the host PS runtime
+    srv = layers.ListenAndServ("127.0.0.1:0")
+    try:
+        from paddle_tpu.pserver import PSClient
+        c = PSClient([srv.endpoint])
+        c.init_param(srv.endpoint, "w", np.ones((2, 2), np.float32),
+                     "sgd", lr=0.1, attrs={})
+        scope = fluid.Scope()
+        got, = layers.Recv(srv.endpoint, ["w"], scope=scope)
+        np.testing.assert_allclose(got, np.ones((2, 2)))
+        scope.set_var("w@GRAD", np.ones((2, 2), np.float32))
+        layers.Send(srv.endpoint, ["w@GRAD"], scope=scope)
+        # sgd with lr .1 on grad of ones: w -> 0.9
+        got2, = layers.Recv(srv.endpoint, ["w"], scope=scope)
+        np.testing.assert_allclose(got2, 0.9 * np.ones((2, 2)), rtol=1e-6)
+    finally:
+        srv.stop()
