@@ -62,16 +62,40 @@ def layer_warp(block_func, input, ch_out, count, stride, is_test=False,
     return res_out
 
 
+def _space_to_depth_stem(input, is_test, data_format):
+    """TPU stem: 2x2 space-to-depth then a 3x3 conv on 12 channels.
+
+    The canonical 7x7/s2 stem runs at ~1.7 TFLOP/s on the MXU because its
+    3 input channels occupy 3 of 128 contraction lanes (measured on-chip;
+    the deep layers hit 76-200 TFLOP/s). Folding a 2x2 pixel block into
+    channels lifts the contraction to 12 lanes and makes the stem stride-1
+    — the standard MLPerf-ResNet TPU transform. Output matches the
+    canonical stem's [B, 112, 112, 64] geometry."""
+    assert data_format == "NHWC", "space_to_depth stem is NHWC-only"
+    H, W, C = input.shape[1], input.shape[2], input.shape[3]
+    assert H % 2 == 0 and W % 2 == 0, \
+        f"space_to_depth stem needs even spatial dims, got {H}x{W}"
+    r = layers.reshape(input, [0, H // 2, 2, W // 2, 2, C])
+    t = layers.transpose(r, perm=[0, 1, 3, 2, 4, 5])
+    std = layers.reshape(t, [0, H // 2, W // 2, 4 * C])
+    return conv_bn_layer(std, ch_out=64, filter_size=3, stride=1, padding=1,
+                         is_test=is_test, data_format=data_format)
+
+
 def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False,
-                    data_format="NCHW"):
+                    data_format="NCHW", stem="conv7"):
     cfg = {18: ([2, 2, 2, 1], basicblock),
            34: ([3, 4, 6, 3], basicblock),
            50: ([3, 4, 6, 3], bottleneck),
            101: ([3, 4, 23, 3], bottleneck),
            152: ([3, 8, 36, 3], bottleneck)}
     stages, block_func = cfg[depth]
-    conv1 = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2, padding=3,
-                          is_test=is_test, data_format=data_format)
+    if stem == "space_to_depth":
+        conv1 = _space_to_depth_stem(input, is_test, data_format)
+    else:
+        conv1 = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2,
+                              padding=3, is_test=is_test,
+                              data_format=data_format)
     pool1 = layers.pool2d(input=conv1, pool_type="max", pool_size=3,
                           pool_stride=2, pool_padding=1,
                           data_format=data_format)
@@ -108,13 +132,14 @@ def resnet_cifar10(input, class_dim=10, depth=32, is_test=False,
 
 
 def build(class_dim=1000, depth=50, image_shape=(3, 224, 224), is_test=False,
-          data_format="NCHW"):
+          data_format="NCHW", stem="conv7"):
     if data_format == "NHWC" and image_shape[0] in (1, 3):
         image_shape = (image_shape[1], image_shape[2], image_shape[0])
     image = layers.data(name="image", shape=list(image_shape), dtype="float32")
     label = layers.data(name="label", shape=[1], dtype="int64")
     predict = resnet_imagenet(image, class_dim=class_dim, depth=depth,
-                              is_test=is_test, data_format=data_format)
+                              is_test=is_test, data_format=data_format,
+                              stem=stem)
     cost = layers.cross_entropy(input=predict, label=label)
     avg_cost = layers.mean(cost)
     acc = layers.accuracy(input=predict, label=label)

@@ -96,3 +96,21 @@ def test_deepfm_trains():
 
     losses = _train(ff, feed, steps=4)
     assert losses[-1] < losses[0] * 1.5
+
+
+def test_resnet_space_to_depth_stem():
+    """TPU stem variant (docs/PERF.md): same output geometry, trains."""
+    from paddle_tpu import models
+    rng = np.random.RandomState(0)
+    batch = {"image": rng.rand(8, 64, 64, 3).astype(np.float32),
+             "label": rng.randint(0, 10, (8, 1)).astype(np.int64)}
+
+    def feed():
+        return batch
+
+    losses = _train(
+        models.resnet.build(class_dim=10, depth=18, image_shape=(3, 64, 64),
+                            data_format="NHWC", stem="space_to_depth"),
+        feed, steps=8,
+        optimizer=fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9))
+    assert losses[-1] < losses[0], losses
