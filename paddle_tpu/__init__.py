@@ -28,6 +28,9 @@ from . import metrics  # noqa: F401
 from . import io  # noqa: F401
 from . import profiler  # noqa: F401
 from . import debugger  # noqa: F401
+from . import ir_pass  # noqa: F401
+from . import enforce  # noqa: F401
+from .enforce import EnforceNotMet  # noqa: F401
 from . import flags  # noqa: F401
 from .flags import get_flag, set_flag  # noqa: F401
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401

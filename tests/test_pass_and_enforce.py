@@ -1,0 +1,108 @@
+"""Pass registry + enforce layer (reference: framework/ir/pass.h
+REGISTER_PASS/PassRegistry, graph_viz_pass.cc; platform/enforce.h)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.ir_pass import (apply_pass, get_pass, register_pass,
+                                registered_passes, Pass)
+
+
+def _lenet_prog():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="x", shape=[1, 8, 8], dtype="float32")
+        c = layers.conv2d(input=x, num_filters=4, filter_size=3, padding=1,
+                          bias_attr=False)
+        b = layers.batch_norm(input=c)
+        y = layers.fc(input=b, size=3, act="softmax")
+    return main, startup, y
+
+
+def test_registry_and_graph_viz(tmp_path):
+    assert {"graph_viz", "memory_optimize", "fuse_batch_norm",
+            "prune_for_inference"} <= set(registered_passes())
+    main, startup, y = _lenet_prog()
+    p = str(tmp_path / "g.dot")
+    apply_pass("graph_viz", main, path=p)
+    assert "conv2d" in open(p).read()
+    with pytest.raises(KeyError, match="unknown pass"):
+        get_pass("nope")
+
+
+def test_fuse_batch_norm_pass_preserves_output():
+    main, startup, y = _lenet_prog()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    xv = np.random.RandomState(0).rand(2, 1, 8, 8).astype(np.float32)
+    infer = main.clone(for_test=True)
+    ref, = exe.run(infer, feed={"x": xv}, fetch_list=[y], scope=scope)
+    fused = apply_pass("fuse_batch_norm", infer, scope=scope)
+    assert "batch_norm" not in [op.type for op in fused.global_block().ops]
+    got, = exe.run(fused, feed={"x": xv}, fetch_list=[y], scope=scope)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_prune_pass_and_custom_pass():
+    main, startup, y = _lenet_prog()
+    pruned = apply_pass("prune_for_inference", main.clone(for_test=True),
+                        targets=[y])
+    assert any(op.type == "conv2d" for op in pruned.global_block().ops)
+
+    @register_pass("strip_softmax_test_only")
+    class StripSoftmax(Pass):
+        def apply(self, program, **kw):
+            blk = program.global_block()
+            blk.ops = [op for op in blk.ops if op.type != "softmax"]
+            return program
+
+    out = apply_pass("strip_softmax_test_only", main.clone(for_test=True))
+    assert all(op.type != "softmax" for op in out.global_block().ops)
+
+
+def test_enforce_family():
+    from paddle_tpu import enforce as E
+    E.enforce(True)
+    E.enforce_eq(3, 3)
+    E.enforce_shape_match((4, 8), (-1, 8))
+    with pytest.raises(fluid.EnforceNotMet, match="enforce_eq"):
+        E.enforce_eq(3, 4)
+    with pytest.raises(fluid.EnforceNotMet, match="shape mismatch"):
+        E.enforce_shape_match((4, 7), (-1, 8))
+    with pytest.raises(fluid.EnforceNotMet, match="batch dim"):
+        E.enforce(False, "batch dim %d not divisible by %d", 7, 2)
+    # capture site is recorded (reference stacktrace-carrying exception)
+    try:
+        E.enforce_gt(1, 2)
+    except fluid.EnforceNotMet as e:
+        assert "enforced at" in str(e)
+
+
+def test_graph_viz_does_not_invalidate_compiled_cache(tmp_path):
+    """Read-only passes must not bump the program version (a bump forces a
+    full recompile of the next step — review regression)."""
+    main, startup, y = _lenet_prog()
+    v0 = main._version
+    apply_pass("graph_viz", main, path=str(tmp_path / "g.dot"))
+    assert main._version == v0
+    apply_pass("memory_optimize", main)
+    assert main._version > v0          # mutating pass DOES bump
+
+
+def test_enforce_reports_the_enforcement_site():
+    from paddle_tpu import enforce as E
+
+    def innocent_outer():
+        return failing_check()
+
+    def failing_check():
+        E.enforce_eq(1, 2)
+
+    try:
+        innocent_outer()
+    except fluid.EnforceNotMet as e:
+        assert "failing_check" in str(e), str(e)
