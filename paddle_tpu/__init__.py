@@ -30,6 +30,8 @@ from . import profiler  # noqa: F401
 from . import debugger  # noqa: F401
 from . import ir_pass  # noqa: F401
 from . import enforce  # noqa: F401
+from . import lod_tensor  # noqa: F401
+from .lod_tensor import create_lod_tensor, create_random_int_lodtensor  # noqa: F401
 from .enforce import EnforceNotMet  # noqa: F401
 from . import flags  # noqa: F401
 from .flags import get_flag, set_flag  # noqa: F401

@@ -180,6 +180,7 @@ class _CompiledProgram:
                 # runtime seqlen propagation (lowering.py) materializes the
                 # @SEQLEN companion of sequence outputs without an explicit op
                 produced.add(n + ir.SEQLEN_SUFFIX)
+                produced.add(n + ir.SEQLEN_SUFFIX + ".1")
                 v = block._find_var_recursive(n)
                 if v is not None and v.persistable and n not in written:
                     written.append(n)
@@ -307,7 +308,16 @@ class Executor:
                     and var.lod_level > 0:
                 data, lens = val
                 feed_arrays[name] = _as_feed_array(data, var)
-                feed_arrays[ir.seqlen_var_name(name)] = np.asarray(lens, np.int32)
+                if isinstance(lens, (tuple, list)) and len(lens) == 2 \
+                        and not np.isscalar(lens[0]):
+                    # nested LoD: (outer counts [B], inner lengths [B, S])
+                    feed_arrays[ir.seqlen_var_name(name)] = \
+                        np.asarray(lens[0], np.int32)
+                    feed_arrays[ir.seqlen_var_name(name, 1)] = \
+                        np.asarray(lens[1], np.int32)
+                else:
+                    feed_arrays[ir.seqlen_var_name(name)] = \
+                        np.asarray(lens, np.int32)
             else:
                 feed_arrays[name] = _as_feed_array(val, var)
 

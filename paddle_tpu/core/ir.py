@@ -34,8 +34,12 @@ def grad_var_name(name: str) -> str:
     return name + GRAD_SUFFIX
 
 
-def seqlen_var_name(name: str) -> str:
-    return name + SEQLEN_SUFFIX
+def seqlen_var_name(name: str, level: int = 0) -> str:
+    """Companion name for the lengths of LoD level `level` (0 = outermost).
+    Level 0 keeps the historical bare suffix; deeper levels append the
+    level index (nested LoD: data [B, S, T, ...] has `@SEQLEN` = [B] outer
+    counts and `@SEQLEN.1` = [B, S] inner lengths)."""
+    return name + SEQLEN_SUFFIX + (f".{level}" if level else "")
 
 
 class Variable:

@@ -216,24 +216,28 @@ def _scatter_outputs(op: ir.Operator, outs: Dict[str, List[Any]], env: Dict[str,
 
 
 def _propagate_seqlen(op: ir.Operator, env: Dict[str, Any]):
-    """Variable-length (LoD-analog) bookkeeping: elementwise-ish ops carry the
-    first input's @SEQLEN companion onto their outputs."""
-    src = None
-    for names in op.inputs.values():
-        for n in names:
-            if n != EMPTY_VAR and (n + SEQLEN_SUFFIX) in env:
-                src = env[n + SEQLEN_SUFFIX]
+    """Variable-length (LoD-analog) bookkeeping: elementwise-ish ops carry
+    the first input's length companions onto their outputs — the bare
+    @SEQLEN (outer level) and, for nested LoD, the @SEQLEN.1 inner
+    lengths."""
+    for suffix in (SEQLEN_SUFFIX, SEQLEN_SUFFIX + ".1"):
+        src = None
+        for names in op.inputs.values():
+            for n in names:
+                if n != EMPTY_VAR and (n + suffix) in env:
+                    src = env[n + suffix]
+                    break
+            if src is not None:
                 break
-        if src is not None:
-            break
-    if src is None:
-        return
-    for names in op.outputs.values():
-        for n in names:
-            if n != EMPTY_VAR and n in env and (n + SEQLEN_SUFFIX) not in env:
-                val = env[n]
-                if hasattr(val, "ndim") and val.ndim >= 2 and val.shape[0] == src.shape[0]:
-                    env[n + SEQLEN_SUFFIX] = src
+        if src is None:
+            continue
+        for names in op.outputs.values():
+            for n in names:
+                if n != EMPTY_VAR and n in env and (n + suffix) not in env:
+                    val = env[n]
+                    if hasattr(val, "ndim") and val.ndim >= 2 \
+                            and val.shape[0] == src.shape[0]:
+                        env[n + suffix] = src
 
 
 def _grad_base(grad_name: str) -> str:

@@ -44,6 +44,32 @@ class DataFeeder:
                 if arr.ndim == 1 and len(var.shape) == 2 and var.shape[-1] == 1:
                     arr = arr.reshape(-1, 1)
                 out[var.name] = arr
+            elif var.lod_level >= 2:
+                # nested sequences: each sample is a list of sequences
+                outer = np.array([len(doc) for doc in col], np.int32)
+                S = max(1, int(outer.max()))
+                inner = np.zeros((len(col), S), np.int32)
+                T = 1
+                feat = None
+                for b, doc in enumerate(col):
+                    for s_i, seq in enumerate(doc):
+                        a = np.asarray(seq, dtype=dtype)
+                        inner[b, s_i] = a.shape[0]
+                        T = max(T, a.shape[0])
+                        if feat is None and a.ndim > 1:
+                            feat = list(a.shape[1:])
+                if pad_to:
+                    T = max(T, pad_to)   # shape-stable steps, as level 1
+                feat = feat or ([1] if len(var.shape) >= 4
+                                and var.shape[-1] == 1 else [])
+                padded = np.zeros([len(col), S, T] + feat, dtype=dtype)
+                for b, doc in enumerate(col):
+                    for s_i, seq in enumerate(doc):
+                        a = np.asarray(seq, dtype=dtype)
+                        if a.ndim == 1 and feat == [1]:
+                            a = a.reshape(-1, 1)
+                        padded[b, s_i, : a.shape[0]] = a
+                out[var.name] = (padded, (outer, inner))
             else:
                 lens = np.array([len(s) for s in col], np.int32)
                 maxlen = max(int(lens.max()), 1)

@@ -158,14 +158,18 @@ class LayerHelper:
         return _to_var(self.block, x)
 
     # -- sequence plumbing -------------------------------------------------
-    def ensure_seqlen_var(self, var: ir.Variable) -> Optional[ir.Variable]:
-        """Materialize the `@SEQLEN` companion Variable for a lod-carrying
-        var so sequence ops can wire it as an explicit input."""
-        if var.lod_level <= 0:
+    def ensure_seqlen_var(self, var: ir.Variable,
+                          level: int = 0) -> Optional[ir.Variable]:
+        """Materialize the lengths companion for LoD level `level` of a
+        lod-carrying var so sequence ops can wire it as an explicit input.
+        Level 0 is the outermost (shape [B]); level 1 the nested inner
+        lengths (shape [B, S])."""
+        if var.lod_level <= level:
             return None
-        name = seqlen_var_name(var.name)
+        name = seqlen_var_name(var.name, level)
         blk = var.block
         if name in blk.vars:
             return blk.vars[name]
-        return blk.create_var(name=name, shape=(-1,), dtype="int32",
+        shape = (-1,) * (level + 1)
+        return blk.create_var(name=name, shape=shape, dtype="int32",
                               stop_gradient=True)

@@ -17,10 +17,12 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
     helper = LayerHelper("data", name=name)
     shape = list(shape)
     if lod_level > 0:
-        # padded variable-length layout: [batch, time, *feature]. The
-        # reference's packed LoD shape [sum_T, *feature] gains an explicit
-        # (dynamic) time dim on TPU.
-        shape = [-1, -1] + shape if append_batch_size else [-1] + shape
+        # padded variable-length layout: one dynamic dim per LoD level
+        # ([batch, time, *feature] at level 1; [batch, seqs, time, *feature]
+        # at level 2). The reference's packed LoD shape [sum_T, *feature]
+        # gains explicit (dynamic) dims on TPU.
+        dyn = [-1] * lod_level
+        shape = ([-1] + dyn + shape) if append_batch_size else (dyn + shape)
     elif append_batch_size:
         shape = [-1] + shape
     block = helper.main_program.current_block()
@@ -30,8 +32,8 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
         v = block.create_var(name=name, shape=shape, dtype=dtype,
                              lod_level=lod_level, stop_gradient=stop_gradient,
                              is_data=True)
-    if lod_level > 0:
-        helper.ensure_seqlen_var(v)
+    for lvl in range(lod_level):
+        helper.ensure_seqlen_var(v, level=lvl)
     return v
 
 

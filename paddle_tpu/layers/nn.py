@@ -625,6 +625,14 @@ def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
 # -- sequence layers (LoD analogs) ------------------------------------------
 
 def _seq_inputs(helper, x, extra=None):
+    if getattr(x, "lod_level", 0) >= 2:
+        # wiring only the outer counts would silently mask the SENTENCE
+        # axis as if it were time — refuse instead (reference sequence ops
+        # act on the innermost level; here only sequence_pool implements
+        # that; pool the inner level first)
+        raise NotImplementedError(
+            f"{helper.layer_type}: nested (level-2) LoD input is only "
+            f"supported by sequence_pool — pool the inner level first")
     inputs = {"X": [x.name]}
     seq = helper.ensure_seqlen_var(x)
     if seq is not None:
@@ -652,6 +660,22 @@ def _alias_seqlen(helper, src, dst):
 def sequence_pool(input, pool_type, is_test=False):
     helper = LayerHelper("sequence_pool")
     out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    if input.lod_level >= 2:
+        # nested LoD: pool the INNERMOST level (reference semantics); the
+        # result keeps the remaining outer level, whose lengths alias the
+        # input's outer companion
+        inner = helper.ensure_seqlen_var(input, level=1)
+        helper.append_op("sequence_pool",
+                         inputs={"X": [input.name],
+                                 "SeqLen": [inner.name]},
+                         outputs={"Out": [out.name]},
+                         attrs={"pooltype": pool_type.upper()})
+        out.lod_level = input.lod_level - 1
+        outer_src = helper.ensure_seqlen_var(input, level=0)
+        outer_dst = helper.ensure_seqlen_var(out, level=0)
+        helper.append_op("assign", inputs={"X": [outer_src.name]},
+                         outputs={"Out": [outer_dst.name]})
+        return out
     helper.append_op("sequence_pool", inputs=_seq_inputs(helper, input),
                      outputs={"Out": [out.name]},
                      attrs={"pooltype": pool_type.upper()})

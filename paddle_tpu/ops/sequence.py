@@ -23,8 +23,18 @@ def _time_mask(SeqLen, T, dtype=jnp.float32):
 @register_op("sequence_pool", propagate_seqlen=False)
 def _sequence_pool(ctx, X, SeqLen=None):
     """[B, T, D] (+lengths) -> [B, D]. pool_type in
-    {average, sum, sqrt, max, last, first} (reference sequence_pool_op.cc)."""
+    {average, sum, sqrt, max, last, first} (reference sequence_pool_op.cc).
+
+    Nested LoD: with X = [B, S, T, D] and SeqLen = inner lengths [B, S],
+    pooling collapses the INNERMOST level (reference semantics: sequence
+    ops act on the last LoD level) -> [B, S, D]; the outer level rides on
+    via the layer's companion aliasing."""
     ptype = ctx.attr("pooltype", "AVERAGE").lower()
+    if SeqLen is not None and SeqLen.ndim == 2:
+        B, S, T = X.shape[0], X.shape[1], X.shape[2]
+        x2 = X.reshape((B * S, T) + tuple(X.shape[3:]))
+        out = _sequence_pool(ctx, x2, SeqLen.reshape(-1))["Out"]
+        return {"Out": out.reshape((B, S) + tuple(out.shape[1:]))}
     B, T = X.shape[0], X.shape[1]
     L = SeqLen if SeqLen is not None else jnp.full((B,), T, jnp.int32)
     m = _time_mask(L, T, X.dtype)

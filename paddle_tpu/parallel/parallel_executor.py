@@ -206,8 +206,16 @@ class ParallelExecutor:
                     and var.lod_level > 0:
                 data, lens = val
                 feed_arrays[name] = self._shard_feed(data, var)
-                feed_arrays[ir.seqlen_var_name(name)] = self._shard_feed(
-                    np.asarray(lens, np.int32), var)
+                if isinstance(lens, (tuple, list)) and len(lens) == 2 \
+                        and not np.isscalar(lens[0]):
+                    # nested LoD: (outer counts [B], inner lengths [B, S])
+                    feed_arrays[ir.seqlen_var_name(name)] = self._shard_feed(
+                        np.asarray(lens[0], np.int32), var)
+                    feed_arrays[ir.seqlen_var_name(name, 1)] = \
+                        self._shard_feed(np.asarray(lens[1], np.int32), var)
+                else:
+                    feed_arrays[ir.seqlen_var_name(name)] = self._shard_feed(
+                        np.asarray(lens, np.int32), var)
             else:
                 feed_arrays[name] = self._shard_feed(val, var)
         return feed_arrays

@@ -1,0 +1,140 @@
+"""Nested (level-2) LoD: padded [B, S, T, ...] + two length companions
+(reference: framework/lod_tensor.h nested levels; lod_tensor.py
+create_lod_tensor). Sequence ops act on the innermost level, outputs keep
+the outer level — the reference's chunked-document pattern."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_create_lod_tensor_roundtrip_two_levels():
+    # 2 samples: first has seqs of 3 and 2 tokens, second one of 4
+    data = np.arange(9, dtype=np.float32).reshape(9, 1)
+    padded, (outer, inner) = fluid.create_lod_tensor(
+        data, [[2, 1], [3, 2, 4]])
+    assert padded.shape == (2, 2, 4, 1)
+    np.testing.assert_array_equal(outer, [2, 1])
+    np.testing.assert_array_equal(inner, [[3, 2], [4, 0]])
+    from paddle_tpu.lod_tensor import lod_to_list
+    back = lod_to_list(padded, (outer, inner))
+    assert back[0][0] == [[0.0], [1.0], [2.0]]
+    assert back[1][0] == [[5.0], [6.0], [7.0], [8.0]]
+    # level mismatch is rejected
+    with pytest.raises(ValueError, match="sums to"):
+        fluid.create_lod_tensor(data, [[2, 1], [3, 2]])
+
+
+def test_nested_sequence_pool_semantics():
+    """Pool the innermost level: docs of sentences of token-embeddings ->
+    per-sentence means with the outer level intact, then an outer pool."""
+    x = layers.data(name="x", shape=[2], dtype="float32", lod_level=2)
+    inner_mean = layers.sequence_pool(x, "average")   # [B, S, 2], lod 1
+    assert inner_mean.lod_level == 1
+    doc_sum = layers.sequence_pool(inner_mean, "sum")  # [B, 2]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    # doc0: sents [[1,1],[3,3]] and [[5,5]]; doc1: sents [[2,2],[4,4],[6,6]]x1tok
+    data = np.array([[1, 1], [3, 3], [5, 5], [2, 2]], np.float32)
+    padded, lens = fluid.create_lod_tensor(data, [[2, 1], [2, 1, 1]])
+    got_inner, got_doc = exe.run(feed={"x": (padded, lens)},
+                                 fetch_list=[inner_mean, doc_sum])
+    got_inner, got_doc = np.asarray(got_inner), np.asarray(got_doc)
+    # doc0 sent0 mean = (1+3)/2 = 2; sent1 = 5. doc1 sent0 = 2
+    np.testing.assert_allclose(got_inner[0, 0], [2, 2])
+    np.testing.assert_allclose(got_inner[0, 1], [5, 5])
+    np.testing.assert_allclose(got_inner[1, 0], [2, 2])
+    # outer sum pools only REAL sentences (outer lengths mask the padding)
+    np.testing.assert_allclose(got_doc[0], [7, 7])
+    np.testing.assert_allclose(got_doc[1], [2, 2])
+
+
+def test_nested_lod_through_feeder_and_training():
+    """DataFeeder builds the nested pair; a doc classifier TRAINS on it."""
+    x = layers.data(name="x", shape=[1], dtype="float32", lod_level=2)
+    y = layers.data(name="y", shape=[1], dtype="int64")
+    sent = layers.sequence_pool(x, "average")          # [B, S, 1]
+    doc = layers.sequence_pool(sent, "average")        # [B, 1]
+    h = layers.fc(input=doc, size=8, act="relu")
+    p = layers.fc(input=h, size=2, act="softmax")
+    loss = layers.mean(layers.cross_entropy(input=p, label=y))
+    fluid.optimizer.Adam(learning_rate=0.1).minimize(loss)
+
+    feeder = fluid.DataFeeder(feed_list=[x, y], place=fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    samples = []
+    for i in range(16):
+        n_sent = rng.randint(1, 4)
+        label = i % 2
+        doc_data = [list(rng.uniform(label, label + 0.5,
+                                     rng.randint(1, 5)).astype(np.float32))
+                    for _ in range(n_sent)]
+        samples.append((doc_data, label))
+    feed = feeder.feed(samples)
+    assert isinstance(feed["x"], tuple) and isinstance(feed["x"][1], tuple)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = [float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0]))
+              for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.5, losses[::6]
+
+
+def test_nested_lod_through_embedding_and_pe():
+    """Review regressions: (a) inner companions propagate through
+    intermediate ops (embedding -> nested pool), (b) ParallelExecutor
+    accepts the nested feed pair."""
+    import jax
+    x = layers.data(name="ids", shape=[1], dtype="int64", lod_level=2)
+    y = layers.data(name="y", shape=[1], dtype="int64")
+    emb = layers.embedding(x, size=[20, 4])
+    emb = layers.reshape(emb, [0, 0, 0, 4])  # squeeze the [.,1] token dim
+    sent = layers.sequence_pool(emb, "average")
+    doc = layers.sequence_pool(sent, "average")
+    p = layers.fc(input=doc, size=2, act="softmax")
+    loss = layers.mean(layers.cross_entropy(input=p, label=y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    feeder = fluid.DataFeeder(feed_list=[x, y], place=fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    samples = []
+    for i in range(8):
+        docd = [list(rng.randint(0, 20, rng.randint(1, 4)))
+                for _ in range(rng.randint(1, 3))]
+        samples.append((docd, i % 2))
+    feed = feeder.feed(samples, pad_to=4)     # pad_to honored (stable T)
+    assert feed["ids"][0].shape[2] == 4
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program(), scope=scope)
+    l0, = exe.run(feed=feed, fetch_list=[loss], scope=scope)
+    assert np.isfinite(np.asarray(l0)).all()
+
+    if len(jax.devices()) >= 8:
+        pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                    scope=scope)
+        lp, = pe.run(feed=feed, fetch_list=[loss.name])
+        assert np.isfinite(np.asarray(lp)).all()
+
+
+def test_non_pool_sequence_ops_reject_nested_input():
+    x = layers.data(name="x2", shape=[3], dtype="float32", lod_level=2)
+    with pytest.raises(NotImplementedError, match="nested"):
+        layers.sequence_softmax(x)
+
+
+def test_create_lod_tensor_nested_list_forms():
+    # ragged nested list (the reference's documented form)
+    padded, lens = fluid.create_lod_tensor([[1, 2, 3], [4, 5]], [[3, 2]])
+    np.testing.assert_array_equal(lens, [3, 2])
+    np.testing.assert_array_equal(padded, [[1, 2, 3], [4, 5, 0]])
+    # rectangular nested list is flattened by token count, not misread as
+    # a feature matrix
+    padded2, lens2 = fluid.create_lod_tensor([[1, 2], [3, 4]], [[2, 2]])
+    np.testing.assert_array_equal(padded2, [[1, 2], [3, 4]])
+    with pytest.raises(ValueError, match="tokens"):
+        fluid.create_lod_tensor([[1, 2, 3]], [[2, 2]])
