@@ -4,19 +4,35 @@ dry-runs the multichip path)."""
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# PADDLE_TPU_TEST_ON_TPU=1 keeps the real chip — use it ONLY to run the
+# TPU-gated files (e.g. `PADDLE_TPU_TEST_ON_TPU=1 pytest
+# tests/test_flash_dropout_tpu.py`): the rest of the suite assumes the
+# 8-device virtual CPU mesh and is skipped on a 1-chip backend.
+_ON_TPU = os.environ.get("PADDLE_TPU_TEST_ON_TPU", "0") == "1"
+if not _ON_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
-# the axon sitecustomize force-registers the TPU backend and overrides
-# jax_platforms; tests must run on the virtual 8-device CPU mesh.
-jax.config.update("jax_platforms", "cpu")
+if not _ON_TPU:
+    # the axon sitecustomize force-registers the TPU backend and overrides
+    # jax_platforms; tests must run on the virtual 8-device CPU mesh.
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    if _ON_TPU and len(jax.devices()) < 8:
+        skip = pytest.mark.skip(reason="PADDLE_TPU_TEST_ON_TPU: suite "
+                                "needs the 8-device virtual CPU mesh")
+        for item in items:
+            if "test_flash_dropout_tpu" not in str(item.fspath):
+                item.add_marker(skip)
 
 
 @pytest.fixture(autouse=True)
