@@ -130,12 +130,17 @@ def bench_resnet(fluid, models, jax, want_flops=False):
                       return_numpy=False, scope=scope)
     _sync(out[0])
 
-    t0 = time.perf_counter()
-    for i in range(steps):
-        out = exe.run(main, feed=batches[i % 4], fetch_list=[loss],
-                      return_numpy=False, scope=scope)
-    _sync(out[0])
-    dt = time.perf_counter() - t0
+    def window():
+        t0 = time.perf_counter()
+        for i in range(steps):
+            out = exe.run(main, feed=batches[i % 4], fetch_list=[loss],
+                          return_numpy=False, scope=scope)
+        _sync(out[0])
+        return time.perf_counter() - t0
+
+    # median of 3 windows: a single tunnel stall once underreported a
+    # config by 5x in a recorded BENCH run
+    dt = sorted(window() for _ in range(3))[1]
     ips = batch_size * steps / dt
     flops = _step_flops(exe, scope, batches[0], jax) if want_flops else 0.0
     return ips, flops * steps / dt
@@ -160,12 +165,16 @@ def bench_transformer(fluid, models, jax, seq_len, batch_size, fused,
         out = exe.run(main, feed=batch, fetch_list=[loss],
                       return_numpy=False, scope=scope)
     _sync(out[0])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = exe.run(main, feed=batch, fetch_list=[loss],
-                      return_numpy=False, scope=scope)
-    _sync(out[0])
-    dt = (time.perf_counter() - t0) / steps
+
+    def window():
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = exe.run(main, feed=batch, fetch_list=[loss],
+                          return_numpy=False, scope=scope)
+        _sync(out[0])
+        return time.perf_counter() - t0
+
+    dt = sorted(window() for _ in range(3))[1] / steps  # median window
     tok_s = batch_size * seq_len / dt
     flops = _step_flops(exe, scope, batch, jax) if want_flops else 0.0
     return tok_s, flops / dt
@@ -234,8 +243,8 @@ def bench_feeder_overlap(fluid, jax, steps=25):
                 scope=scope)
         break
 
-    t_sync = run_once(iter(host_batches))
-    t_async = run_once(iter(make_feeder()))
+    t_sync = sorted(run_once(iter(host_batches)) for _ in range(3))[1]
+    t_async = sorted(run_once(iter(make_feeder())) for _ in range(3))[1]
     return steps * 16 / t_sync, steps * 16 / t_async
 
 
@@ -254,14 +263,19 @@ def main():
                                         want_flops=True)
     tok_fus, _ = bench_transformer(fluid, models, jax, seq_len=256,
                                    batch_size=64, fused=True)
-    # like-for-like pair at long context (flash attention territory)
-    tok_long_fus, tf2k_fps = bench_transformer(fluid, models, jax,
+    # like-for-like pair at long context (flash attention territory).
+    # MFU for the flash configs reuses the UNFUSED program's XLA-counted
+    # FLOPs-per-token: the Pallas kernel is a custom call whose FLOPs XLA
+    # cannot see, but the model math per token is identical.
+    tok_long_unf, tf2k_fps = bench_transformer(fluid, models, jax,
                                                seq_len=2048, batch_size=8,
-                                               fused=True, steps=8, warmup=3,
-                                               want_flops=True)
-    tok_long_unf, _ = bench_transformer(fluid, models, jax, seq_len=2048,
-                                        batch_size=8, fused=False, steps=8,
+                                               fused=False, steps=8,
+                                               warmup=3, want_flops=True)
+    tok_long_fus, _ = bench_transformer(fluid, models, jax, seq_len=2048,
+                                        batch_size=8, fused=True, steps=8,
                                         warmup=3)
+    flops_per_tok_2k = tf2k_fps / tok_long_unf if tok_long_unf else 0.0
+    fus2k_fps = flops_per_tok_2k * tok_long_fus
     sync_ips, async_ips = bench_feeder_overlap(fluid, jax)
 
     print(json.dumps({
@@ -277,7 +291,7 @@ def main():
             "transformer_mfu": round(tf_fps / peak, 3),
             "transformer_seq2048_flash_tokens_per_sec": round(tok_long_fus, 0),
             "transformer_seq2048_unfused_tokens_per_sec": round(tok_long_unf, 0),
-            "transformer_seq2048_mfu": round(tf2k_fps / peak, 3),
+            "transformer_seq2048_mfu": round(fus2k_fps / peak, 3),
             "feeder_sync_images_per_sec": round(sync_ips, 1),
             "feeder_async_images_per_sec": round(async_ips, 1),
             "feeder_h2d_overlap_speedup": round(async_ips / sync_ips, 2),

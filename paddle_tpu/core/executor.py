@@ -321,9 +321,11 @@ class Executor:
             else:
                 feed_arrays[name] = _as_feed_array(val, var)
 
+        from .. import flags as _flags
         cache_key = (program._uid, program._version,
                      tuple(sorted(feed_arrays)), tuple(fetch_names),
-                     scope._uid, self.amp, self.check_nan_inf)
+                     scope._uid, self.amp, self.check_nan_inf,
+                     _flags.get_flag("dropout_impl"))
         compiled = self._cache.get(cache_key) if use_program_cache else None
         if compiled is None:
             with jax.default_device(self.place.jax_device()):

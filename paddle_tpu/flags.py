@@ -17,6 +17,10 @@ _DEFS: Dict[str, tuple] = {
     "check_nan_inf": (False, bool),   # reference FLAGS_check_nan_inf
     "benchmark": (False, bool),       # reference FLAGS_benchmark
     "profile": (False, bool),
+    # dropout lowering: "auto"/"xla" = the fused counter-hash XLA path
+    # (measured default, docs/PERF.md); "pallas" forces the in-kernel-PRNG
+    # Pallas kernel on eligible tensors for A/B measurement
+    "dropout_impl": ("auto", str),
 }
 
 _FLAGS: Dict[str, Any] = {}
@@ -32,7 +36,13 @@ def _init():
     for name, (default, typ) in _DEFS.items():
         env = os.environ.get(f"PADDLE_TPU_{name.upper()}",
                              os.environ.get(f"FLAGS_{name}"))
-        _FLAGS[name] = _coerce(env, typ) if env is not None else default
+        val = _coerce(env, typ) if env is not None else default
+        if name in _CHOICES and env is not None:
+            val = str(val).lower()
+            if val not in _CHOICES[name]:
+                raise ValueError(f"flag {name!r} must be one of "
+                                 f"{_CHOICES[name]}, got {val!r}")
+        _FLAGS[name] = val
 
 
 def get_flag(name: str):
@@ -41,9 +51,21 @@ def get_flag(name: str):
     return _FLAGS[name]
 
 
+# enumerated string flags: value must be one of the choices (a typo like
+# dropout_impl=palas would otherwise silently select the default path)
+_CHOICES: Dict[str, tuple] = {
+    "dropout_impl": ("auto", "pallas", "xla"),
+}
+
+
 def set_flag(name: str, value):
     if name not in _FLAGS:
         raise KeyError(f"unknown flag {name!r}; known: {sorted(_FLAGS)}")
+    if name in _CHOICES:
+        value = str(value).lower()
+        if value not in _CHOICES[name]:
+            raise ValueError(
+                f"flag {name!r} must be one of {_CHOICES[name]}, got {value!r}")
     _FLAGS[name] = value
 
 

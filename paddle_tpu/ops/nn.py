@@ -191,11 +191,16 @@ def _dropout(ctx, X):
     # The kernel's custom_vjp regenerates the mask from the seed, so no
     # mask tensor ever hits HBM.
     from . import pallas_dropout
-    # applies to any lane-aligned tensor, 4-D attention weights included:
-    # with the lane-preserving 2D view the kernel beats the XLA path even
-    # there (XLA materializes grouped u8 mask tensors for the score chain
-    # — measured +3% step time vs the kernel at seq 256)
-    if (impl == "upscale_in_train" and jax.default_backend() != "cpu"
+    from .. import flags as _flags
+    # Path choice (measured, docs/PERF.md): the Pallas kernel's in-kernel
+    # PRNG made it the winner over threefry-fed XLA dropout, but it is a
+    # fusion barrier — one extra read+write of the tensor fwd AND bwd.
+    # With the counter-hash bits path (below) the XLA version fuses into
+    # the surrounding chain at ~zero HBM cost, so "auto" prefers it; the
+    # kernel stays selectable for A/B via FLAGS dropout_impl=pallas.
+    impl_flag = _flags.get_flag("dropout_impl")
+    if (impl_flag == "pallas"
+            and impl == "upscale_in_train" and jax.default_backend() != "cpu"
             and pallas_dropout.supports(X, p)):
         seed = (jax.random.key_data(ctx.key).reshape(-1)[0]
                 .astype(jnp.int32).reshape(1, 1))
@@ -219,11 +224,36 @@ def _dropout(ctx, X):
     return {"Out": out, "Mask": mask.astype(X.dtype)}
 
 
+def _hash_bits8(key, shape):
+    """One random byte per element from a counter hash: murmur3's fmix32
+    avalanche over the element's linear index, seeded from the op's
+    fold_in'd PRNG key. Dropout-grade randomness (the reference draws from
+    cuRAND Philox, dropout_op.cu — also a counter hash, more rounds) at
+    ~8 fused integer ops per element; jax.random.bits' threefry is a
+    ~100-op unfused block chain that dominated the VPU cost of every
+    dropout site it fed."""
+    kd = jax.random.key_data(key).reshape(-1).astype(jnp.uint32)
+    seed = kd[0] ^ (kd[-1] * np.uint32(0x9E3779B9))
+    idx = jnp.zeros(shape, jnp.uint32)   # 0-d tensors: index 0
+    stride = 1
+    for d in range(len(shape) - 1, -1, -1):
+        term = jax.lax.broadcasted_iota(jnp.uint32, shape, d)
+        if stride != 1:
+            term = term * np.uint32(stride)
+        idx = idx + term
+        stride *= int(shape[d])
+    x = idx * np.uint32(2654435761) + seed
+    x = (x ^ (x >> 16)) * np.uint32(0x85EBCA6B)
+    x = (x ^ (x >> 13)) * np.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return (x & np.uint32(0xFF)).astype(jnp.uint8)
+
+
 def _keep_bits(key, shape, p):
     t = round((1.0 - p) * 256) - 1
     if t < 0:                       # p ~ 1: nothing survives
         return jnp.zeros(shape, bool)
-    return jax.random.bits(key, shape, np.uint8) <= np.uint8(min(255, t))
+    return _hash_bits8(key, shape) <= np.uint8(min(255, t))
 
 
 import functools as _functools

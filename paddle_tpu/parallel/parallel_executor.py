@@ -164,8 +164,10 @@ class ParallelExecutor:
                        for f in fetch_list]
         feed_arrays = self._convert_feeds(feed)
 
+        from .. import flags as _flags
         key = (self._program._uid, self._program._version,
-               tuple(sorted(feed_arrays)), tuple(fetch_names))
+               tuple(sorted(feed_arrays)), tuple(fetch_names),
+               _flags.get_flag("dropout_impl"))
         self._last_key = key
         compiled = self._cache.get(key)
         if compiled is None:

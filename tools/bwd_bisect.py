@@ -1,0 +1,122 @@
+"""Localize the framework-vs-yardstick BACKWARD traffic gap by component:
+compile tiny train programs (embed+loss / +ffn / +attention) through the
+framework and as hand-written JAX, and compare XLA cost-analysis bytes.
+
+python tools/bwd_bisect.py   (compiles on whatever backend jax picks)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+B, T, D, V, DI, H = 64, 256, 512, 30000, 2048, 8
+
+
+def fw(kind):
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.models.transformer import multi_head_attention, ffn
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        src = layers.data(name="src", shape=[-1, T], dtype="int64",
+                          append_batch_size=False)
+        lbl = layers.data(name="lbl", shape=[-1, T], dtype="int64",
+                          append_batch_size=False)
+        x = layers.embedding(src, size=[V, D])
+        if kind in ("ffn", "both"):
+            x = ffn(x, D, DI, 0.0, False, name="f0")
+        if kind in ("attn", "both"):
+            x = multi_head_attention(x, x, D, H, 0.0, name="a0", fused=False)
+        logits = layers.fc(input=x, size=V, num_flatten_dims=2,
+                           bias_attr=False)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits=logits, label=lbl))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0), amp=True)
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    batch = {"src": rng.randint(1, V, (B, T)).astype(np.int32),
+             "lbl": rng.randint(1, V, (B, T)).astype(np.int32)}
+    exe.run(main, feed=batch, fetch_list=[loss], return_numpy=False,
+            scope=scope)
+    c = max(exe._cache.values(),
+            key=lambda c: len(c.program.global_block().ops))
+    mut = {n: scope.find_var(n) for n in c.mut_names}
+    const = {n: scope.find_var(n) for n in c.const_names}
+    comp = c._step.lower(batch, mut, const, jax.random.key(0)).compile()
+    ca = comp.cost_analysis()
+    return ca.get("bytes accessed", 0), ca.get("flops", 0), comp
+
+
+def ys(kind):
+    import jax
+    import jax.numpy as jnp
+
+    r = np.random.RandomState(0)
+    b16 = jnp.bfloat16
+
+    params = {"emb": jnp.zeros((V, D)), "out": jnp.zeros((D, V))}
+    if kind in ("ffn", "both"):
+        params["f"] = {"w1": jnp.zeros((D, DI)), "b1": jnp.zeros((DI,)),
+                       "w2": jnp.zeros((DI, D)), "b2": jnp.zeros((D,))}
+    if kind in ("attn", "both"):
+        params["a"] = {k: jnp.zeros((D, D)) for k in ("wq", "wk", "wv", "wo")}
+    batch = {"src": jnp.asarray(r.randint(1, V, (B, T)), jnp.int32),
+             "lbl": jnp.asarray(r.randint(1, V, (B, T)), jnp.int32)}
+
+    def loss_fn(p):
+        x = p["emb"][batch["src"]].astype(b16)
+        if kind in ("ffn", "both"):
+            f = p["f"]
+            h = jax.nn.relu(x @ f["w1"].astype(b16) + f["b1"].astype(b16))
+            x = h @ f["w2"].astype(b16) + f["b2"].astype(b16)
+        if kind in ("attn", "both"):
+            a = p["a"]
+            dh = D // H
+
+            def heads(t):
+                return t.reshape(B, T, H, dh).transpose(0, 2, 1, 3)
+
+            q = heads(x @ a["wq"].astype(b16))
+            k = heads(x @ a["wk"].astype(b16))
+            v = heads(x @ a["wv"].astype(b16))
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (dh ** -0.5)
+            w = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", w, v)
+            x = ctx.transpose(0, 2, 1, 3).reshape(B, T, D) @ a["wo"].astype(b16)
+        logits = (x @ p["out"].astype(b16)).astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, batch["lbl"][..., None],
+                                   axis=-1)[..., 0]
+        return jnp.mean(lse - gold)
+
+    @jax.jit
+    def step(p):
+        l, g = jax.value_and_grad(loss_fn)(p)
+        return jax.tree.map(lambda p, g: p - 0.1 * g, p, g), l
+
+    comp = step.lower(params).compile()
+    ca = comp.cost_analysis()
+    return ca.get("bytes accessed", 0), ca.get("flops", 0), comp
+
+
+def main():
+    for kind in ("none", "ffn", "attn"):
+        fb, ff, fc_ = fw(kind)
+        yb, yf, yc = ys(kind)
+        print(f"{kind:5} fw={fb:.3e} ys={yb:.3e} ratio={fb / yb:.3f} | "
+              f"flops fw={ff:.3e} ys={yf:.3e}", flush=True)
+        open(f"/tmp/fw_{kind}.hlo", "w").write(fc_.as_text())
+        open(f"/tmp/ys_{kind}.hlo", "w").write(yc.as_text())
+
+
+if __name__ == "__main__":
+    main()

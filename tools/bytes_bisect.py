@@ -1,0 +1,96 @@
+"""Bisect the framework-vs-yardstick HBM-traffic gap (docs/PERF.md):
+compile both transformer train steps under toggled features (dropout off,
+AMP off, fwd-only) and print XLA cost-analysis bytes for each, so the
+extra traffic is attributed to a component instead of hand-waved.
+
+CPU-safe (structure/cost only): JAX_PLATFORMS=cpu python tools/bytes_bisect.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def fw_bytes(dropout=0.1, amp=True, opt=True, batch_size=64, seq_len=256):
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        feeds, fetches = models.transformer.build(seq_len=seq_len,
+                                                  dropout_rate=dropout,
+                                                  fused_attention=False)
+        loss = fetches["loss"]
+        if opt:
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0), amp=amp)
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    batch = {k: rng.randint(1, 30000, (batch_size, seq_len)).astype(np.int32)
+             for k in ("src_word", "trg_word", "lbl_word")}
+    exe.run(main, feed=batch, fetch_list=[loss], return_numpy=False,
+            scope=scope)
+    compiled = max(exe._cache.values(),
+                   key=lambda c: len(c.program.global_block().ops))
+    mut = {n: scope.find_var(n) for n in compiled.mut_names}
+    const = {n: scope.find_var(n) for n in compiled.const_names}
+    feed_arrays = {k: batch[k] for k in sorted(batch)}
+    ca = (compiled._step.lower(feed_arrays, mut, const, jax.random.key(0))
+          .compile().cost_analysis())
+    return ca.get("bytes accessed", 0.0), ca.get("flops", 0.0)
+
+
+def ys_bytes(dropout=0.1, opt=True):
+    import jax
+    from tools import yardstick_transformer as y
+
+    params = y.init_params(0)
+    batch = y.make_batch()
+    key = jax.random.key(0)
+
+    if opt:
+        opt_state = y.adam_init(params)
+
+        @jax.jit
+        def step(params, opt_state, batch, key):
+            loss, grads = jax.value_and_grad(y.loss_fn)(params, batch, key,
+                                                        rate=dropout)
+            params, opt_state = y.adam_update(params, grads, opt_state)
+            return params, opt_state, loss
+
+        lowered = step.lower(params, opt_state, batch, key)
+    else:
+        @jax.jit
+        def fwd(params, batch, key):
+            return y.loss_fn(params, batch, key, rate=dropout)
+
+        lowered = fwd.lower(params, batch, key)
+    ca = lowered.compile().cost_analysis()
+    return ca.get("bytes accessed", 0.0), ca.get("flops", 0.0)
+
+
+def main():
+    rows = []
+    for label, kw_fw, kw_ys in [
+        ("full (dropout .1, amp, adam)", dict(), dict()),
+        ("dropout off", dict(dropout=0.0), dict(dropout=0.0)),
+        ("fwd only (no adam)", dict(opt=False), dict(opt=False)),
+        ("fwd only, dropout off", dict(opt=False, dropout=0.0),
+         dict(opt=False, dropout=0.0)),
+    ]:
+        fb, ff = fw_bytes(**kw_fw)
+        yb, yf = ys_bytes(**kw_ys)
+        rows.append((label, fb, yb, ff, yf))
+        print(f"{label:32} fw={fb:.3e} ys={yb:.3e} "
+              f"ratio={fb / yb:.3f} | flops fw={ff:.3e} ys={yf:.3e}")
+
+
+if __name__ == "__main__":
+    main()

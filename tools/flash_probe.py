@@ -1,0 +1,53 @@
+"""Probe the fused_attention (Pallas flash) path at seq 256: time the
+transformer step fused vs unfused, with dropout on/off, to attribute the
+flash@256 slowdown seen in bench (in-kernel 4-D weight dropout vs the
+XLA path). TPU-only; prints ms/step per config."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def run(fused, dropout, seq_len=256, batch_size=64, steps=10, warmup=3):
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        feeds, fetches = models.transformer.build(seq_len=seq_len,
+                                                  dropout_rate=dropout,
+                                                  fused_attention=fused)
+        loss = fetches["loss"]
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0), amp=True)
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    batch = {k: jax.device_put(
+        rng.randint(1, 30000, (batch_size, seq_len)).astype(np.int32))
+        for k in ("src_word", "trg_word", "lbl_word")}
+    for _ in range(warmup):
+        out = exe.run(main, feed=batch, fetch_list=[loss],
+                      return_numpy=False, scope=scope)
+    np.asarray(out[0])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = exe.run(main, feed=batch, fetch_list=[loss],
+                      return_numpy=False, scope=scope)
+    np.asarray(out[0])
+    dt = (time.perf_counter() - t0) / steps
+    print(f"fused={fused} dropout={dropout}: {dt * 1e3:7.1f} ms/step "
+          f"({batch_size * seq_len / dt:9.0f} tok/s)", flush=True)
+
+
+if __name__ == "__main__":
+    for fused in (False, True):
+        for dropout in (0.0, 0.1):
+            run(fused, dropout)

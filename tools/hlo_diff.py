@@ -1,0 +1,134 @@
+"""Compare the framework-compiled transformer train step against the
+hand-written JAX yardstick (tools/yardstick_transformer.py): optimized-HLO
+op histograms side by side, plus wall-clock timing when run on a device.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/hlo_diff.py          # structure only
+    python tools/hlo_diff.py --time                      # + timing (TPU)
+
+The histogram diff localizes Program/IR-layer overhead: extra `convert`s
+point at AMP casting churn, extra `transpose`/`reshape` at layout churn,
+extra `fusion`s at fragmentation, `rng`/`custom-call` rows at dropout
+implementation differences (docs/PERF.md "Remaining gap" section).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def hlo_histogram(text: str) -> collections.Counter:
+    ops = collections.Counter()
+    for line in text.splitlines():
+        line = line.strip()
+        m = re.match(r"(?:ROOT )?[%\w.\-]+ = \S+ ([\w\-]+)\(", line)
+        if m:
+            ops[m.group(1)] += 1
+    return ops
+
+
+def framework_step(batch_size=64, seq_len=256):
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        feeds, fetches = models.transformer.build(seq_len=seq_len,
+                                                  fused_attention=False)
+        loss = fetches["loss"]
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0), amp=True)
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    batch = {k: rng.randint(1, 30000, (batch_size, seq_len)).astype(np.int32)
+             for k in ("src_word", "trg_word", "lbl_word")}
+
+    def run():
+        return exe.run(main, feed=batch, fetch_list=[loss],
+                       return_numpy=False, scope=scope)
+
+    out = run()  # compile
+    compiled = max(exe._cache.values(),
+                   key=lambda c: len(c.program.global_block().ops))
+    mut = {n: scope.find_var(n) for n in compiled.mut_names}
+    const = {n: scope.find_var(n) for n in compiled.const_names}
+    feed_arrays = {k: batch[k] for k in sorted(batch)}
+    lowered = compiled._step.lower(feed_arrays, mut, const, jax.random.key(0))
+    return lowered.compile(), run, out
+
+
+def yardstick_step():
+    import jax
+    from tools import yardstick_transformer as y
+
+    params = y.init_params(0)
+    opt = y.adam_init(params)
+    batch = y.make_batch()
+    key = jax.random.key(0)
+    lowered = y.train_step.lower(params, opt, batch, key)
+    state = {"p": params, "o": opt}
+
+    def run():
+        state["p"], state["o"], loss = y.train_step(state["p"], state["o"],
+                                                    batch, key)
+        return [loss]
+
+    return lowered.compile(), run, run()
+
+
+def time_steps(run, steps=12):
+    out = run()
+    np.asarray(out[0])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = run()
+    np.asarray(out[0])
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    fw_compiled, fw_run, _ = framework_step()
+    ys_compiled, ys_run, _ = yardstick_step()
+
+    fw_hist = hlo_histogram(fw_compiled.as_text())
+    ys_hist = hlo_histogram(ys_compiled.as_text())
+
+    keys = sorted(set(fw_hist) | set(ys_hist),
+                  key=lambda k: -(fw_hist[k] - ys_hist[k]))
+    print(f"{'hlo op':28} {'framework':>10} {'yardstick':>10} {'delta':>7}")
+    for k in keys:
+        d = fw_hist[k] - ys_hist[k]
+        if fw_hist[k] or ys_hist[k]:
+            print(f"{k:28} {fw_hist[k]:>10} {ys_hist[k]:>10} {d:>+7}")
+    print(f"{'TOTAL':28} {sum(fw_hist.values()):>10} "
+          f"{sum(ys_hist.values()):>10} "
+          f"{sum(fw_hist.values()) - sum(ys_hist.values()):>+7}")
+
+    for label, compiled in (("framework", fw_compiled),
+                            ("yardstick", ys_compiled)):
+        try:
+            ca = compiled.cost_analysis()
+            print(f"{label}: flops={ca.get('flops', 0):.3e} "
+                  f"bytes={ca.get('bytes accessed', 0):.3e}")
+        except Exception as e:
+            print(f"{label}: cost analysis unavailable ({e!r})")
+
+    if "--time" in sys.argv:
+        fw_ms = time_steps(fw_run) * 1e3
+        ys_ms = time_steps(ys_run) * 1e3
+        print(f"framework {fw_ms:.1f} ms/step | yardstick {ys_ms:.1f} ms/step "
+              f"| overhead {fw_ms / ys_ms:.2f}x")
+
+
+if __name__ == "__main__":
+    main()
