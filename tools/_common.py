@@ -1,0 +1,21 @@
+"""Shared helper for the perf tools: compile a framework program's main
+training step and return a jax `Compiled` for cost analysis / HLO dumps.
+
+Centralizes the private-API dance (pick the largest cached step, collect
+mut/const state, lower+compile) so a change to Executor internals breaks
+one place, not three."""
+
+from __future__ import annotations
+
+
+def compile_main_step(exe, scope, feed):
+    """exe must have run the program at least once with `feed`."""
+    import jax
+
+    compiled = max(exe._cache.values(),
+                   key=lambda c: len(c.program.global_block().ops))
+    mut = {n: scope.find_var(n) for n in compiled.mut_names}
+    const = {n: scope.find_var(n) for n in compiled.const_names}
+    feeds = {k: feed[k] for k in sorted(feed)}
+    return (compiled._step.lower(feeds, mut, const, jax.random.key(0))
+            .compile())

@@ -47,11 +47,8 @@ def fw(kind):
              "lbl": rng.randint(1, V, (B, T)).astype(np.int32)}
     exe.run(main, feed=batch, fetch_list=[loss], return_numpy=False,
             scope=scope)
-    c = max(exe._cache.values(),
-            key=lambda c: len(c.program.global_block().ops))
-    mut = {n: scope.find_var(n) for n in c.mut_names}
-    const = {n: scope.find_var(n) for n in c.const_names}
-    comp = c._step.lower(batch, mut, const, jax.random.key(0)).compile()
+    from tools._common import compile_main_step
+    comp = compile_main_step(exe, scope, batch)
     ca = comp.cost_analysis()
     return ca.get("bytes accessed", 0), ca.get("flops", 0), comp
 

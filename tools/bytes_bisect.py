@@ -37,13 +37,8 @@ def fw_bytes(dropout=0.1, amp=True, opt=True, batch_size=64, seq_len=256):
              for k in ("src_word", "trg_word", "lbl_word")}
     exe.run(main, feed=batch, fetch_list=[loss], return_numpy=False,
             scope=scope)
-    compiled = max(exe._cache.values(),
-                   key=lambda c: len(c.program.global_block().ops))
-    mut = {n: scope.find_var(n) for n in compiled.mut_names}
-    const = {n: scope.find_var(n) for n in compiled.const_names}
-    feed_arrays = {k: batch[k] for k in sorted(batch)}
-    ca = (compiled._step.lower(feed_arrays, mut, const, jax.random.key(0))
-          .compile().cost_analysis())
+    from tools._common import compile_main_step
+    ca = compile_main_step(exe, scope, batch).cost_analysis()
     return ca.get("bytes accessed", 0.0), ca.get("flops", 0.0)
 
 

@@ -58,13 +58,8 @@ def framework_step(batch_size=64, seq_len=256):
                        return_numpy=False, scope=scope)
 
     out = run()  # compile
-    compiled = max(exe._cache.values(),
-                   key=lambda c: len(c.program.global_block().ops))
-    mut = {n: scope.find_var(n) for n in compiled.mut_names}
-    const = {n: scope.find_var(n) for n in compiled.const_names}
-    feed_arrays = {k: batch[k] for k in sorted(batch)}
-    lowered = compiled._step.lower(feed_arrays, mut, const, jax.random.key(0))
-    return lowered.compile(), run, out
+    from tools._common import compile_main_step
+    return compile_main_step(exe, scope, batch), run, out
 
 
 def yardstick_step():

@@ -30,7 +30,10 @@ def _dropout(key, x, rate):
     # surrounding chain (same trick as paddle_tpu/ops/nn.py:_hash_bits8)
     if not rate:
         return x
-    thresh = np.uint8(round((1.0 - rate) * 256.0) - 1)
+    t = round((1.0 - rate) * 256.0) - 1
+    if t < 0:                      # rate ~ 1: drop everything
+        return jnp.zeros_like(x)
+    thresh = np.uint8(min(255, t))
     kd = jax.random.key_data(key).reshape(-1).astype(jnp.uint32)
     seed = kd[0] ^ (kd[-1] * np.uint32(0x9E3779B9))
     idx, stride = None, 1
