@@ -1,0 +1,65 @@
+"""`paddle` compatibility namespace (reference: python/paddle/__init__.py).
+
+Reference user scripts — `import paddle`, `import paddle.fluid as fluid`,
+`import paddle.fluid.layers as layers`, `paddle.batch(...)`,
+`paddle.dataset.mnist.train()`, `paddle.reader.shuffle(...)` — run
+unchanged against the TPU-native implementation.
+
+A meta-path finder redirects EVERY `paddle.fluid[.X]`, `paddle.dataset[.X]`
+and `paddle.reader[.X]` import to the corresponding paddle_tpu module, so
+submodule-form imports resolve to the SAME live module objects — without
+it, `import paddle.fluid.layers` would re-execute paddle_tpu.layers under
+a second name and fork global state (op registry, default programs).
+"""
+
+import importlib
+import importlib.abc
+import importlib.util
+import sys as _sys
+
+_MAP = {
+    "paddle.fluid": "paddle_tpu",
+    "paddle.dataset": "paddle_tpu.dataset",
+    "paddle.reader": "paddle_tpu.reader",
+}
+
+
+class _AliasLoader(importlib.abc.Loader):
+    def __init__(self, real_name):
+        self._real = real_name
+
+    def create_module(self, spec):
+        return importlib.import_module(self._real)  # the existing module
+
+    def exec_module(self, module):
+        pass  # already executed under its real name
+
+
+class _AliasFinder(importlib.abc.MetaPathFinder):
+    def find_spec(self, fullname, path=None, target=None):
+        for prefix, real in _MAP.items():
+            if fullname == prefix or fullname.startswith(prefix + "."):
+                real_name = real + fullname[len(prefix):]
+                return importlib.util.spec_from_loader(
+                    fullname, _AliasLoader(real_name))
+        return None
+
+
+if not any(isinstance(f, _AliasFinder) for f in _sys.meta_path):
+    _sys.meta_path.insert(0, _AliasFinder())
+
+import paddle_tpu as fluid  # noqa: F401,E402
+from paddle_tpu import dataset, reader  # noqa: F401,E402
+from paddle_tpu.reader.decorator import batch as _batch  # noqa: E402
+
+_sys.modules[__name__ + ".fluid"] = fluid
+_sys.modules[__name__ + ".dataset"] = dataset
+_sys.modules[__name__ + ".reader"] = reader
+
+
+def batch(reader, batch_size, drop_last=False):
+    """reference batch.py:18 — keeps the tail batch by default."""
+    return _batch(reader, batch_size, drop_last=drop_last)
+
+
+__version__ = fluid.__version__

@@ -11,9 +11,10 @@ Architecture (see SURVEY.md for the reference blueprint):
 
 from .core import ir as _ir
 from .core.ir import (Program, program_guard, default_main_program,  # noqa: F401
-                      default_startup_program, Variable, Parameter)
+                      default_startup_program, Variable, Parameter, Operator)
 from .core.executor import (Executor, Scope, global_scope,  # noqa: F401
-                            CPUPlace, TPUPlace, CUDAPlace, EOFException)
+                            CPUPlace, TPUPlace, CUDAPlace, EOFException,
+                            scope_guard, _switch_scope, fetch_var)
 from .core.backward import append_backward, calc_gradient  # noqa: F401
 
 from . import ops  # noqa: F401  (registers all lowering rules)
@@ -28,6 +29,13 @@ from . import metrics  # noqa: F401
 from . import io  # noqa: F401
 from . import profiler  # noqa: F401
 from . import debugger  # noqa: F401
+from . import evaluator  # noqa: F401
+from . import average  # noqa: F401
+from . import annotations  # noqa: F401
+from . import default_scope_funcs  # noqa: F401
+from . import recordio_writer  # noqa: F401
+from .recordio_writer import (convert_reader_to_recordio_file,  # noqa: F401
+                              convert_reader_to_recordio_files)
 from . import ir_pass  # noqa: F401
 from . import enforce  # noqa: F401
 from . import lod_tensor  # noqa: F401
@@ -69,3 +77,13 @@ def is_compiled_with_tpu() -> bool:
 def tpu_device_count() -> int:
     import jax
     return len(jax.devices())
+
+
+def get_var(name, program=None):
+    """Look up a Variable by name in a program's global block (reference
+    framework.py get_var)."""
+    program = program or default_main_program()
+    v = program.global_block()._find_var_recursive(name)
+    if v is None:
+        raise ValueError(f"get_var: no variable named {name!r}")
+    return v

@@ -347,3 +347,36 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+
+
+import contextlib as _contextlib
+
+
+def _switch_scope(scope: Scope) -> Scope:
+    """Swap the process-global scope, returning the previous one
+    (reference executor.py _switch_scope)."""
+    global _global_scope
+    prev = _global_scope
+    _global_scope = scope
+    return prev
+
+
+@_contextlib.contextmanager
+def scope_guard(scope: Scope):
+    """Run a `with` region against `scope` as the global scope (reference
+    executor.py scope_guard)."""
+    prev = _switch_scope(scope)
+    try:
+        yield
+    finally:
+        _switch_scope(prev)
+
+
+def fetch_var(name: str, scope: Optional[Scope] = None, return_numpy: bool = True):
+    """Read a variable's current value from a scope (reference
+    executor.py fetch_var)."""
+    scope = scope or global_scope()
+    val = scope.find_var(name)
+    if val is None:
+        raise KeyError(f"fetch_var: variable {name!r} not found in scope")
+    return np.asarray(val) if return_numpy else val

@@ -151,3 +151,29 @@ def _global_weight_initializer():
 
 def _global_bias_initializer():
     return ConstantInitializer(0.0)
+
+
+# -- CPU-pinning knobs (reference initializer.py force_init_on_cpu /
+# init_on_cpu). The reference pinned initializer ops to CPU to dodge GPU
+# RNG divergence; on TPU startup programs are one deterministic XLA
+# computation keyed on the program seed, so the knob is semantically a
+# no-op — the API is kept for source compatibility.
+
+import contextlib as _contextlib
+
+_force_init_on_cpu = False
+
+
+def force_init_on_cpu():
+    return _force_init_on_cpu
+
+
+@_contextlib.contextmanager
+def init_on_cpu():
+    global _force_init_on_cpu
+    prev = _force_init_on_cpu
+    _force_init_on_cpu = True
+    try:
+        yield
+    finally:
+        _force_init_on_cpu = prev

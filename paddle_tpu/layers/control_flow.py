@@ -702,3 +702,64 @@ class IfElse:
                    "true_outs": list(self._outs["true"]),
                    "false_outs": list(self._outs["false"])})
         return outs
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Runtime tensor printing (reference control_flow.py:143). Lowered to
+    a host callback (jax.debug.print) firing from inside the compiled
+    step; first_n/print_phase filtering is host-side cosmetics the
+    callback cannot replicate exactly, so every access prints."""
+    helper = LayerHelper("print")
+    prefix = (message + " ") if message else ""
+    if print_tensor_name:
+        prefix += input.name + " "
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("print", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"message": prefix, "summarize": summarize})
+    out.lod_level = input.lod_level
+    return out
+
+
+def is_empty(x, cond=None):
+    """Whether `x` has zero elements (reference control_flow.py is_empty)."""
+    helper = LayerHelper("is_empty")
+    out = cond or helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op("is_empty", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+class ParallelDo:
+    """Block-level data parallelism (reference parallel_do_op.cc:115,
+    control_flow.py ParallelDo).
+
+    TPU-native: the reference split the batch across places and ran the
+    sub-block per device on threads; under GSPMD the WHOLE program is
+    partitioned over the mesh, so the correct lowering of a parallel_do
+    region is simply its body over the full batch — ParallelExecutor
+    shards the batch dim and inserts the gradient all-reduce the
+    reference's merge step performed (docs/RETIREMENT.md, P2->P1
+    subsumption). This shim keeps source compatibility: do() traces the
+    body inline; read_input/write_output are identity bookkeeping."""
+
+    def __init__(self, places, use_nccl=False, name=None):
+        self.helper = LayerHelper("parallel_do", name=name)
+        self._inputs = []
+
+    @contextlib.contextmanager
+    def do(self):
+        yield
+
+    def read_input(self, var):
+        self._inputs.append(var)
+        return var
+
+    def write_output(self, var):
+        self._out = var
+
+    def __call__(self):
+        return self._out

@@ -117,8 +117,112 @@ def multiclass_nms(bboxes, scores, score_threshold=0.01, nms_top_k=400,
     return out, count
 
 
-detection_output = multiclass_nms  # reference detection_output wraps
-# box_coder decode + multiclass_nms; compose explicitly when deltas are fed
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     name=None):
+    """Decode predicted deltas against priors, then NMS (reference
+    detection.py detection_output = box_coder(decode_center_size) +
+    multiclass_nms). loc [B,M,4], scores [B,M,C] (softmax-ed here, as the
+    reference does), priors [M,4]."""
+    from .. import layers as _layers
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    probs = _layers.transpose(_nn.softmax(scores), perm=[0, 2, 1])
+    return multiclass_nms(decoded, probs,
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold,
+                          background_label=background_label,
+                          nms_eta=nms_eta, name=name)
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD prediction head (reference detection.py multi_box_head): per
+    feature map, a prior_box + 3x3 convs for location and confidence;
+    outputs concatenated over maps. Returns (mbox_locs [B,M,4],
+    mbox_confs [B,M,C], boxes [M,4], variances [M,4])."""
+    from .. import layers as _layers
+
+    n = len(inputs)
+    if not min_sizes:
+        # the reference's ratio schedule (detection.py multi_box_head):
+        # sizes evenly spaced in [min_ratio, max_ratio]% of base_size,
+        # with a fixed 10%/20% pair prepended for the first map
+        if n <= 2 or min_ratio is None or max_ratio is None:
+            raise ValueError("multi_box_head: give min_sizes or "
+                             "min_ratio/max_ratio with >2 inputs")
+        min_sizes, max_sizes = [], []
+        step = int((max_ratio - min_ratio) / (n - 2))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes
+        max_sizes = [base_size * 0.20] + max_sizes
+
+    locs, confs, boxes_l, vars_l = [], [], [], []
+    for i, feat in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        ars = aspect_ratios[i] if isinstance(aspect_ratios[i], (list, tuple)) \
+            else [aspect_ratios[i]]
+        st = steps[i] if steps else [
+            step_w[i] if step_w else 0.0, step_h[i] if step_h else 0.0]
+        box, var = prior_box(
+            feat, image, [mins] if not isinstance(mins, (list, tuple)) else
+            list(mins),
+            [maxs] if maxs and not isinstance(maxs, (list, tuple)) else
+            (list(maxs) if maxs else None),
+            ars, variance, flip, clip,
+            st if isinstance(st, (list, tuple)) else [st, st], offset,
+            min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+        # must match the prior_box op's count exactly for the conv channel
+        # split to line up — use the op's own expansion, never a copy
+        from ..ops.detection import _expand_aspect_ratios
+        expanded = _expand_aspect_ratios(ars, flip)
+        mins_list = mins if isinstance(mins, (list, tuple)) else [mins]
+        num_priors = (len(expanded) + (1 if maxs else 0)) * len(mins_list)
+        loc = _nn.conv2d(input=feat, num_filters=num_priors * 4,
+                         filter_size=kernel_size, padding=pad, stride=stride)
+        loc = _layers.transpose(loc, perm=[0, 2, 3, 1])
+        loc = _layers.reshape(loc, shape=[0, -1, 4])
+        locs.append(loc)
+        conf = _nn.conv2d(input=feat, num_filters=num_priors * num_classes,
+                          filter_size=kernel_size, padding=pad, stride=stride)
+        conf = _layers.transpose(conf, perm=[0, 2, 3, 1])
+        conf = _layers.reshape(conf, shape=[0, -1, num_classes])
+        confs.append(conf)
+        boxes_l.append(_layers.reshape(box, shape=[-1, 4]))
+        vars_l.append(_layers.reshape(var, shape=[-1, 4]))
+
+    mbox_locs = _t.concat(locs, axis=1)
+    mbox_confs = _t.concat(confs, axis=1)
+    boxes = _t.concat(boxes_l, axis=0)
+    variances = _t.concat(vars_l, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_version="integral", name=None):
+    """Per-batch mean average precision (reference detection_map_op.cc).
+    detect_res [B,D,6] (label, score, x1,y1,x2,y2; label=-1 padding, the
+    multiclass_nms output layout), label [B,G,6] ground truth
+    (label, difficult, x1,y1,x2,y2) padded with label=-1."""
+    helper = LayerHelper("detection_map", name=name)
+    out, = _op(helper, "detection_map",
+               {"DetectRes": [detect_res.name], "Label": [label.name]},
+               ("MAP",),
+               {"class_num": class_num, "background_label": background_label,
+                "overlap_threshold": overlap_threshold,
+                "evaluate_difficult": evaluate_difficult,
+                "ap_version": ap_version})
+    return out
 
 
 def polygon_box_transform(input, name=None):

@@ -140,3 +140,137 @@ def Recv(endpoint, var_names, scope=None, sync=True):
         scope.set_var(n, val)
         out.append(val)
     return out
+
+
+def read_file(reader):
+    """Pop the reader's output variables (reference io.py read_file). Feeds
+    are explicit in this executor design — the PyReader's feed vars ARE
+    the read results, armed to pop from the blocking queue on each run."""
+    if isinstance(reader, (tuple, list)):
+        reader, feed_vars = reader
+    else:
+        feed_vars = reader.feed_vars
+    return feed_vars if len(feed_vars) > 1 else feed_vars[0]
+
+
+def shuffle(reader, buffer_size):
+    """Shuffling reader decorator surfaced at the layers level (reference
+    io.py shuffle, which wrapped an in-graph reader; the in-graph reader
+    tree is subsumed by python readers + py_reader, docs/RETIREMENT.md)."""
+    from ..reader import decorator as dec
+    return dec.shuffle(reader, buffer_size)
+
+
+def batch(reader, batch_size):
+    """Batching reader decorator at the layers level (reference io.py
+    batch -> create_batch_reader). Keeps the final partial batch like the
+    reference; pass drop_last=True via reader.decorator.batch when static
+    batch shapes matter (avoids one extra jit per tail shape)."""
+    from ..reader import decorator as dec
+    return dec.batch(reader, batch_size, drop_last=False)
+
+
+def open_files(filenames, shapes, dtypes, lod_levels=None, thread_num=1,
+               buffer_size=None, pass_num=1, for_parallel=True):
+    """Multi-file RecordIO reader (reference io.py:699 open_files):
+    round-robin scan of the files feeding one blocking queue."""
+    import pickle
+    from .. import recordio as rio
+    from ..reader.py_reader import py_reader as _impl
+
+    reader, feed_vars = _impl(capacity=buffer_size or 64, shapes=shapes,
+                              dtypes=dtypes, lod_levels=lod_levels)
+
+    def scan():
+        for _ in range(pass_num):
+            batch_ = []
+            for fn in filenames:
+                for rec in rio.reader(fn)():
+                    batch_.append(pickle.loads(rec))
+                    if len(batch_) == 16:
+                        yield batch_
+                        batch_ = []
+            if batch_:
+                yield batch_
+
+    reader.decorate_paddle_reader(scan)
+    return reader, feed_vars
+
+
+def random_data_generator(low, high, shapes, lod_levels=None, for_parallel=True):
+    """Uniform-random python reader (reference io.py random_data_generator,
+    used by reader-op tests): yields tuples of float32 arrays."""
+    import numpy as np
+
+    def reader():
+        rng = np.random.RandomState(0)
+        while True:
+            yield tuple(rng.uniform(low, high, s).astype(np.float32)
+                        for s in shapes)
+
+    return reader
+
+
+def load(out, file_path, load_as_fp16=None):
+    """Load a saved array into `out` at run time (reference io.py load ->
+    load op). The file is one np.save'd array as written by
+    paddle_tpu.io.save_vars(save_separately)."""
+    helper = LayerHelper("load")
+    helper.append_op("load", outputs={"Out": [out.name]},
+                     attrs={"file_path": file_path,
+                            "load_as_fp16": bool(load_as_fp16)})
+    return out
+
+
+class Preprocessor:
+    """In-graph batch preprocessing (reference io.py:943 Preprocessor).
+
+    The user declares the preprocessing body with regular layers inside
+    `.block()`; the body is captured as its own mini Program and jit-run
+    on each batch popped from the source reader — the TPU analog of the
+    reference's create_custom_reader sub-block."""
+
+    def __init__(self, reader, name=None):
+        self._source = reader
+        self._in_vars = None
+        self._out_vars = None
+        self._program = None
+        self._startup = None
+
+    def inputs(self, dtypes, shapes):
+        assert self._program is not None, "call inside .block()"
+        self._in_vars = [
+            data(name=f"_preproc_in_{i}", shape=list(s), dtype=d,
+                 append_batch_size=False)
+            for i, (d, s) in enumerate(zip(dtypes, shapes))]
+        return self._in_vars
+
+    def outputs(self, *outs):
+        self._out_vars = list(outs)
+
+    def block(self):
+        import contextlib
+        from .. import program_guard, Program, unique_name
+
+        @contextlib.contextmanager
+        def guard():
+            self._program, self._startup = Program(), Program()
+            with program_guard(self._program, self._startup), \
+                    unique_name.guard():
+                yield self
+        return guard()
+
+    def __call__(self):
+        from ..core.executor import Executor, CPUPlace, Scope
+        assert self._in_vars and self._out_vars, \
+            "Preprocessor.block() must declare inputs() and outputs()"
+        exe = Executor(CPUPlace())
+        scope = Scope()
+        exe.run(self._startup, scope=scope)
+
+        def reader():
+            for item in self._source():
+                feed = {v.name: arr for v, arr in zip(self._in_vars, item)}
+                yield tuple(exe.run(self._program, feed=feed,
+                                    fetch_list=self._out_vars, scope=scope))
+        return reader

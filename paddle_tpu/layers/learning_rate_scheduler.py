@@ -119,3 +119,28 @@ def noam_decay(d_model, warmup_steps):
     a = step ** -0.5
     b = step * (warmup_steps ** -1.5)
     return (d_model ** -0.5) * ops.elementwise_min(a, b)
+
+
+def append_LARS(params_grads, learning_rate, weight_decay):
+    """Layer-wise adaptive rate scaling (reference
+    learning_rate_scheduler.py append_LARS): per-parameter
+    lr = global_lr * ||w|| / (||g|| + weight_decay * ||w||), stored on the
+    parameter's optimize_attr so Optimizer._create_param_lr picks it up."""
+    from . import nn
+
+    def _balanced_weight(param_norm, grad_norm):
+        if weight_decay == 1.0:
+            return grad_norm + param_norm
+        return grad_norm + weight_decay * param_norm
+
+    for param, grad in params_grads:
+        param_lr = param.optimize_attr.get("learning_rate", 1.0)
+        param_norm = ops.sqrt(nn.reduce_sum(ops.square(param)))
+        grad_norm = ops.sqrt(nn.reduce_sum(ops.square(grad)))
+        if isinstance(param_lr, float) and param_lr == 1.0:
+            decayed_lr = learning_rate * param_norm \
+                / _balanced_weight(param_norm, grad_norm)
+        else:
+            decayed_lr = learning_rate * param_lr * param_norm \
+                / _balanced_weight(param_norm, grad_norm)
+        param.optimize_attr["learning_rate"] = decayed_lr

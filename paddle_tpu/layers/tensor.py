@@ -106,8 +106,26 @@ def argmin(x, axis=0):
                        dtype="int64")
 
 
-def argsort(x, axis=-1):
-    raise NotImplementedError("argsort: use topk for ranked retrieval on TPU")
+def argsort(x, axis=-1, name=None):
+    """Sorted values + indices (reference tensor.py argsort)."""
+    helper = LayerHelper("argsort", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    ids = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op("argsort", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name], "Indices": [ids.name]},
+                     attrs={"axis": axis})
+    return out, ids
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Create a learnable Parameter directly (reference tensor.py
+    create_parameter) — same path fc/conv use via LayerHelper."""
+    from ..param_attr import ParamAttr
+    helper = LayerHelper("create_parameter", name=name)
+    attr = attr or ParamAttr(name=name)
+    return helper.create_parameter(attr, shape, dtype, is_bias=is_bias,
+                                   default_initializer=default_initializer)
 
 
 def zeros(shape, dtype="float32"):

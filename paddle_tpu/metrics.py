@@ -130,3 +130,66 @@ class Auc(MetricBase):
         fpr = fp / max(fp[-1], 1.0)
         return float(np.trapezoid(tpr, fpr)) if hasattr(np, "trapezoid") \
             else float(np.trapz(tpr, fpr))
+
+
+class Precision(MetricBase):
+    """Binary precision tp/(tp+fp) (reference metrics.py Precision):
+    update with sigmoid scores (rounded at 0.5) and {0,1} labels."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fp += int(np.sum((preds == 1) & (labels == 0)))
+
+    def eval(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Recall(MetricBase):
+    """Binary recall tp/(tp+fn) (reference metrics.py Recall)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fn += int(np.sum((preds == 0) & (labels == 1)))
+
+    def eval(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+
+class DetectionMAP(MetricBase):
+    """Accumulator over per-batch mAP values produced by
+    layers.detection_map — EXACT reference semantics (metrics.py
+    DetectionMAP.update accumulates the bare value and divides by the
+    accumulated weight, so with the documented usage weight=batch_size the
+    result is sum(batch_mAP)/sum(batch_size), NOT a weighted mean; ported
+    scripts get the reference's numbers). Pass weight=1 per batch for a
+    plain mean."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight):
+        self.value += float(np.asarray(value).reshape(-1)[0])
+        self.weight += float(np.asarray(weight).reshape(-1)[0])
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("DetectionMAP: no batches accumulated")
+        return self.value / self.weight

@@ -10,3 +10,4 @@ from . import stacked_dynamic_lstm  # noqa: F401
 from . import transformer  # noqa: F401
 from . import deepfm  # noqa: F401
 from . import machine_translation  # noqa: F401
+from . import se_resnext  # noqa: F401

@@ -481,3 +481,99 @@ def _rpn_target_assign(ctx, Anchor, GtBox, DistMat):
     if DistMat.ndim == 2:
         labels, match = labels[0], match[0]
     return {"Labels": labels, "MatchIndices": match}
+
+
+@register_op("detection_map", propagate_seqlen=False)
+def _detection_map(ctx, DetectRes, Label):
+    """Batch mean-average-precision (reference detection_map_op.h).
+
+    Static-shape redesign of the LoD inputs: DetectRes [B,D,6] rows
+    (label, score, x1,y1,x2,y2) padded with label=-1 (the multiclass_nms
+    output layout); Label [B,G,6] rows (label, difficult, x1,y1,x2,y2)
+    padded with label=-1. Greedy VOC matching runs as a lax.scan over the
+    globally score-sorted detections carrying the per-GT matched mask, so
+    two detections can never claim the same ground-truth box.
+    """
+    class_num = int(ctx.attr("class_num"))
+    background = int(ctx.attr("background_label", 0))
+    thr = float(ctx.attr("overlap_threshold", 0.5))
+    eval_difficult = bool(ctx.attr("evaluate_difficult", True))
+    ap_version = ctx.attr("ap_version", "integral")
+
+    B, D, _ = DetectRes.shape
+    G = Label.shape[1]
+    det_label = DetectRes[:, :, 0].reshape(-1)              # [N]
+    det_score = DetectRes[:, :, 1].reshape(-1)
+    det_box = DetectRes[:, :, 2:6].reshape(-1, 4)
+    img_idx = jnp.repeat(jnp.arange(B), D)
+
+    gt_label = Label[:, :, 0]                               # [B,G]
+    gt_difficult = Label[:, :, 1] > 0.5
+    gt_box = Label[:, :, 2:6]                               # [B,G,4]
+    gt_valid = gt_label >= 0
+
+    valid = det_label >= 0
+    order = jnp.argsort(jnp.where(valid, -det_score, jnp.inf))
+    det_label = det_label[order]
+    det_box = det_box[order]
+    img_idx = img_idx[order]
+    valid = valid[order]
+
+    def iou(box, boxes):
+        ix1 = jnp.maximum(box[0], boxes[:, 0])
+        iy1 = jnp.maximum(box[1], boxes[:, 1])
+        ix2 = jnp.minimum(box[2], boxes[:, 2])
+        iy2 = jnp.minimum(box[3], boxes[:, 3])
+        iw = jnp.maximum(ix2 - ix1, 0.0)
+        ih = jnp.maximum(iy2 - iy1, 0.0)
+        inter = iw * ih
+        a1 = jnp.maximum(box[2] - box[0], 0.0) * jnp.maximum(box[3] - box[1], 0.0)
+        a2 = (jnp.maximum(boxes[:, 2] - boxes[:, 0], 0.0)
+              * jnp.maximum(boxes[:, 3] - boxes[:, 1], 0.0))
+        return inter / jnp.maximum(a1 + a2 - inter, 1e-10)
+
+    def step(matched, det):
+        lbl, box, img, ok = det
+        g_lbl = gt_label[img]                                # [G]
+        g_box = gt_box[img]
+        same = (g_lbl == lbl) & gt_valid[img]
+        ious = jnp.where(same, iou(box, g_box), -1.0)
+        best = jnp.argmax(ious)
+        hit = ious[best] >= thr
+        diff = gt_difficult[img, best]
+        already = matched[img, best]
+        ignore = hit & diff & (not eval_difficult)
+        tp = ok & hit & ~already & ~(diff & (not eval_difficult))
+        fp = ok & ~ignore & ~tp
+        matched = matched.at[img, best].set(already | tp)
+        return matched, (tp, fp)
+
+    matched0 = jnp.zeros((B, G), bool)
+    _, (tp, fp) = jax.lax.scan(
+        step, matched0, (det_label, det_box, img_idx, valid))
+
+    classes = jnp.arange(class_num)                          # [C]
+    countable = gt_valid & (eval_difficult | ~gt_difficult)
+    npos = jnp.sum((gt_label[None, :, :] == classes[:, None, None])
+                   & countable[None, :, :], axis=(1, 2)).astype(jnp.float32)
+
+    cls_mask = (det_label[None, :] == classes[:, None])      # [C,N]
+    tp_c = jnp.cumsum(tp[None, :] * cls_mask, axis=1).astype(jnp.float32)
+    fp_c = jnp.cumsum(fp[None, :] * cls_mask, axis=1).astype(jnp.float32)
+    prec = tp_c / jnp.maximum(tp_c + fp_c, 1e-10)
+    n_safe = jnp.maximum(npos, 1.0)[:, None]
+    if ap_version == "11point":
+        recall = tp_c / n_safe
+        ts = jnp.arange(11, dtype=jnp.float32) / 10.0        # [11]
+        at_t = jnp.max(jnp.where((recall[:, None, :] >= ts[None, :, None])
+                                 & cls_mask[:, None, :], prec[:, None, :],
+                                 0.0), axis=2)               # [C,11]
+        ap = jnp.mean(at_t, axis=1)
+    else:
+        # integral: each TP adds precision-at-that-point / npos
+        ap = jnp.sum(prec * (tp[None, :] * cls_mask), axis=1) / n_safe[:, 0]
+
+    has_pos = (npos > 0) & (classes != background)
+    m = jnp.sum(jnp.where(has_pos, ap, 0.0)) / jnp.maximum(
+        jnp.sum(has_pos.astype(jnp.float32)), 1.0)
+    return {"MAP": m.reshape((1,))}

@@ -307,3 +307,92 @@ def _uniform_random_bsl(ctx, Input):
     dtype = types.np_dtype(ctx.attr("dtype", "float32"))
     return {"Out": jax.random.uniform(ctx.key, tuple(shape), dtype,
                                       ctx.attr("min", -1.0), ctx.attr("max", 1.0))}
+
+
+@register_op("argsort")
+def _argsort(ctx, X):
+    """Sorted values + indices (reference argsort_op.cc). XLA lowers sort
+    to an efficient TPU sorting network; the old "use top_k" guidance
+    predated that and is retired."""
+    axis = ctx.attr("axis", -1)
+    idx = jnp.argsort(X, axis=axis)
+    out = jnp.take_along_axis(X, idx, axis=axis)
+    return {"Out": out, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("is_empty")
+def _is_empty(ctx, X):
+    """True iff the tensor holds zero elements (reference is_empty_op.cc).
+    Shapes are static under XLA, so this folds to a constant."""
+    import numpy as _np
+    return {"Out": jnp.asarray(int(_np.prod(X.shape)) == 0).reshape((1,))}
+
+
+@register_op("print")
+def _print(ctx, X):
+    """Runtime tensor printing (reference print_op.cc) via jax.debug.print:
+    the callback fires from the compiled program on the host, so it works
+    inside the single-XLA-step executor. Out aliases the input so the op
+    can be inserted mid-graph without changing the math."""
+    message = ctx.attr("message", "") or ""
+    summarize = int(ctx.attr("summarize", -1))
+    flat = X.reshape(-1)
+    shown = flat[:summarize] if summarize > 0 else flat
+    # user text goes through brace-escaping: it must never be interpreted
+    # as format placeholders by jax.debug.print
+    prefix = (message + "shape=" + str(tuple(X.shape))) \
+        .replace("{", "{{").replace("}", "}}")
+    if _runtime_print_supported():
+        jax.debug.print(prefix + " {x}", x=shown)
+    else:
+        # e.g. the axon PJRT tunnel: no host send/recv callbacks — a
+        # debug.print in the program would abort the whole step at run
+        # time. Degrade to a trace-time banner (fires once per compile;
+        # un-escaped text, this is a plain host print).
+        print(f"[print op: {message}shape={tuple(X.shape)} — runtime value "
+              f"printing unavailable on this backend]")
+    return {"Out": X}
+
+
+_PRINT_PROBE = None
+
+
+def _runtime_print_supported() -> bool:
+    """Whether the backend executes host callbacks (jax.debug.print).
+    Probed once with a throwaway jit — backends that lack send/recv
+    (the axon dev tunnel) raise UNIMPLEMENTED only at execution time and
+    report their platform as plain 'tpu', so a name check cannot work."""
+    global _PRINT_PROBE
+    if _PRINT_PROBE is None:
+        import numpy as _np
+
+        def _f(x):
+            jax.debug.print("{x}", x=x)
+            return x + 1
+        try:
+            _np.asarray(jax.jit(_f)(jnp.zeros((1,), jnp.float32)))
+            jax.effects_barrier()
+            _PRINT_PROBE = True
+        except Exception:
+            _PRINT_PROBE = False
+    return _PRINT_PROBE
+
+
+@register_op("load")
+def _load(ctx):
+    """Load one np.save'd array (reference load_op.cc). The file is read at
+    trace time and baked into the compiled step as a constant — re-run the
+    startup/load program to pick up a changed file (same contract as the
+    reference: load runs when its program runs)."""
+    import numpy as _np
+    path = ctx.attr("file_path")
+    if not path.endswith(".npy"):
+        try:
+            arr = _np.load(path)
+        except FileNotFoundError:
+            arr = _np.load(path + ".npy")
+    else:
+        arr = _np.load(path)
+    if ctx.attr("load_as_fp16"):
+        arr = arr.astype(_np.float16)
+    return {"Out": jnp.asarray(arr)}

@@ -118,11 +118,16 @@ class Optimizer:
         return optimize_ops, params_grads
 
     def _lr_for_param(self, param):
-        """Per-parameter lr multiplier (ParamAttr.learning_rate)."""
+        """Per-parameter lr multiplier (ParamAttr.learning_rate). A
+        Variable is used directly — append_LARS stores the per-layer
+        decayed lr here (reference optimizer.py _create_param_lr
+        special-cases Variable the same way)."""
+        from .core import ir
         mult = getattr(param, "optimize_attr", {}).get("learning_rate", 1.0)
+        if isinstance(mult, ir.Variable):
+            return mult
         if mult == 1.0:
             return self._lr_var
-        from .layers import tensor as lt
         return self._lr_var * float(mult)
 
 

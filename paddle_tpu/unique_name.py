@@ -34,3 +34,12 @@ def guard(new_generator=None):
         yield
     finally:
         _generator = prev
+
+
+def switch(new_generator=None):
+    """Swap the global generator, returning the old one (reference
+    unique_name.switch — guard() is built on it there)."""
+    global _generator
+    prev = _generator
+    _generator = new_generator or UniqueNameGenerator()
+    return prev

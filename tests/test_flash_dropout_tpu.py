@@ -14,6 +14,17 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+
+@pytest.fixture(autouse=True)
+def _fd_precision():
+    """FD probes against bf16-default TPU matmuls read ~5x off; raise the
+    precision for THIS file only and restore it after (a module-level
+    config.update would leak into every other collected test)."""
+    prev = jax.config.jax_default_matmul_precision
+    jax.config.update("jax_default_matmul_precision", "highest")
+    yield
+    jax.config.update("jax_default_matmul_precision", prev)
+
 from paddle_tpu.ops.pallas_attention import flash_attention
 
 pytestmark = pytest.mark.skipif(

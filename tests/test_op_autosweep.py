@@ -154,6 +154,9 @@ SPECS = {
     "mean": Spec(inputs={"X": T(3, 4)}),
     "sum": Spec(inputs={"X": [T(2, 3), T(2, 3), T(2, 3)]}),
     "arg_max": Spec(inputs={"X": T(2, 5) * 5}, attrs={"axis": 1}, grad=[]),
+    "argsort": Spec(inputs={"X": T(3, 6)}, attrs={"axis": -1},
+                    outs=("Out", "Indices"), grad=[]),
+    "is_empty": Spec(inputs={"X": T(2, 3)}, grad=[]),
     "arg_min": Spec(inputs={"X": T(2, 5) * 5}, attrs={"axis": 1}, grad=[]),
     "top_k": Spec(inputs={"X": T(2, 8) * 5}, attrs={"k": 3},
                   outs=("Out", "Indices"), grad=[]),
@@ -561,6 +564,9 @@ WAIVED = {
     "mine_hard_examples": "neg mining counts; tests/test_detection.py",
     "polygon_box_transform": "pixel transform; tests/test_detection.py",
     "rpn_target_assign": "label assignment; tests/test_detection.py",
+    "print": "host-callback side effect; tests/test_api_breadth.py",
+    "load": "reads a file at trace time; tests/test_api_breadth.py",
+    "detection_map": "mAP vs brute force; tests/test_api_breadth.py",
 }
 
 

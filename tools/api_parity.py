@@ -1,0 +1,138 @@
+"""Public-API parity audit against the reference's python/paddle/fluid.
+
+For every reference module with an `__all__`, check that each exported
+symbol is importable from the corresponding paddle_tpu module. Prints a
+per-module report; `missing_symbols()` returns the gap list so
+tests/test_api_parity.py can assert it stays empty modulo the documented
+waivers (retired subsystems, CUDA-only knobs).
+
+The reference sources contain py2 syntax (1L literals), so __all__ is
+extracted with a regex rather than ast.parse.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REF = "/root/reference/python/paddle/fluid"
+
+# (reference module, paddle_tpu attribute path)
+MODULES = [
+    ("layers/nn.py", "layers"),
+    ("layers/tensor.py", "layers"),
+    ("layers/control_flow.py", "layers"),
+    ("layers/io.py", "layers"),
+    ("layers/detection.py", "layers"),
+    ("layers/metric_op.py", "layers"),
+    ("layers/learning_rate_scheduler.py", "layers"),
+    ("layers/device.py", "layers"),
+    ("initializer.py", "initializer"),
+    ("optimizer.py", "optimizer"),
+    ("regularizer.py", "regularizer"),
+    ("clip.py", "clip"),
+    ("metrics.py", "metrics"),
+    ("nets.py", "nets"),
+    ("io.py", "io"),
+    ("backward.py", "backward"),
+    ("framework.py", None),           # top-level paddle_tpu
+    ("executor.py", None),
+    ("parallel_executor.py", None),
+    ("param_attr.py", None),
+    ("data_feeder.py", None),
+    ("lod_tensor.py", None),
+    ("profiler.py", "profiler"),
+    ("unique_name.py", "unique_name"),
+    ("trainer.py", "trainer"),
+    ("inferencer.py", "trainer"),     # Inferencer lives beside Trainer
+    ("transpiler/__init__.py", "transpiler"),
+    ("evaluator.py", "evaluator"),
+    ("average.py", "average"),
+    ("annotations.py", "annotations"),
+    ("default_scope_funcs.py", "default_scope_funcs"),
+    ("recordio_writer.py", "recordio_writer"),
+    ("concurrency.py", None),         # every export waived (retired)
+]
+
+# Reference exports deliberately not re-implemented, with the decision of
+# record. The parity test treats these as satisfied.
+WAIVED = {
+    # CSP concurrency experiment: retired with rationale in
+    # docs/RETIREMENT.md (XLA has no op-interpreter loop to overlap).
+    ("concurrency.py", "Go"),
+    ("concurrency.py", "make_channel"),
+    ("concurrency.py", "channel_send"),
+    ("concurrency.py", "channel_recv"),
+    ("concurrency.py", "channel_close"),
+    ("concurrency.py", "Select"),
+}
+
+
+def ref_all(path: str):
+    src = open(os.path.join(REF, path)).read()
+    m = re.search(r"__all__\s*=\s*\[(.*?)\]", src, re.S)
+    if not m:
+        return []
+    return re.findall(r"['\"]([A-Za-z_][\w.]*)['\"]", m.group(1))
+
+
+def _resolve(root, attr_path, name):
+    obj = root
+    if attr_path:
+        for part in attr_path.split("."):
+            obj = getattr(obj, part, None)
+            if obj is None:
+                return False
+    if hasattr(obj, name):
+        return True
+    # layers/* symbols are also commonly reached from the package root
+    return attr_path is None and hasattr(root.layers, name)
+
+
+def missing_symbols():
+    import paddle_tpu
+
+    gaps = []  # (ref_module, symbol)
+    for path, attr in MODULES:
+        for name in ref_all(path):
+            if (path, name) in WAIVED:
+                continue
+            found = _resolve(paddle_tpu, attr, name)
+            if not found and attr is not None:
+                found = hasattr(paddle_tpu, name)   # promoted to top level
+            if not found:
+                gaps.append((path, name))
+    return gaps
+
+
+def main():
+    import paddle_tpu
+
+    total = ok = 0
+    by_mod = {}
+    waived_count = 0
+    for path, attr in MODULES:
+        names = ref_all(path)
+        waived = [n for n in names if (path, n) in WAIVED]
+        live = [n for n in names if (path, n) not in WAIVED]
+        missing = [n for n in live
+                   if not (_resolve(paddle_tpu, attr, n)
+                           or (attr is not None and hasattr(paddle_tpu, n)))]
+        total += len(live)
+        ok += len(live) - len(missing)
+        waived_count += len(waived)
+        by_mod[path] = (len(names), missing)
+        status = "OK " if not missing else "GAP"
+        print(f"{status} {path:42} {len(live) - len(missing)}/{len(live)}"
+              + (f"  missing: {missing}" if missing else "")
+              + (f"  waived: {waived}" if waived else ""))
+    print(f"\ncoverage: {ok}/{total} "
+          f"({100.0 * ok / total:.1f}%) reference exports present; "
+          f"{waived_count} waived (retired subsystems, see docs/RETIREMENT.md)")
+
+
+if __name__ == "__main__":
+    main()
