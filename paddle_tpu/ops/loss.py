@@ -12,7 +12,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..core.registry import register_op
+from ..core.registry import register_op, register_grad
 
 
 def _squeeze_label(Label):
@@ -54,6 +54,57 @@ def _softmax_with_cross_entropy(ctx, Logits, Label):
         ignore = ctx.attr("ignore_index", -100)
         loss = jnp.where(ids[..., None] == ignore, 0.0, loss)
     return {"Softmax": softmax.astype(Logits.dtype), "Loss": loss.astype(Logits.dtype)}
+
+
+@register_grad("softmax_with_cross_entropy")
+def _swce_grad(ctx, ins, out_grads):
+    """Hand-written grad: dLogits = (softmax - onehot) * dLoss, recomputed
+    from the logits instead of letting jax.vjp save the f32 softmax as a
+    residual. The generic path materialized an f32 [B,T,V] probabilities
+    tensor between forward and backward — 2 GB at (64,256,30k) and the
+    allocation that OOM'd batch 256; here the f32 math lives only inside
+    one fusion and dLogits lands directly in the logits dtype (bf16 under
+    AMP — which is what the out-projection grad matmuls consume anyway)."""
+    Logits, Label = ins["Logits"][0], ins["Label"][0]
+    gL = out_grads.get("Loss", [None])[0]
+    gS = out_grads.get("Softmax", [None])[0]
+    logits32 = Logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits32, axis=-1, keepdims=True)
+    softmax = jnp.exp(logits32 - lse)           # fused into the consumers
+    d = jnp.zeros_like(softmax)
+    soft_label = ctx.attr("soft_label", False)
+    d_label = None
+    if soft_label and jnp.issubdtype(Label.dtype, jnp.floating):
+        # always materialize the Label cotangent: backward.py may have
+        # declared Label@GRAD even when only the Softmax output is used
+        d_label = jnp.zeros(Label.shape, Label.dtype)
+    if gL is not None:
+        gL32 = gL.astype(jnp.float32)
+        if soft_label:
+            lab32 = Label.astype(jnp.float32)
+            # exact: d/dLogits[-sum(L*log_softmax)] = sum(L)*softmax - L
+            # (reduces to softmax - L only when rows sum to 1; unnormalized
+            # soft targets are legal inputs and the vjp this replaces was
+            # exact for them)
+            lsum = jnp.sum(lab32, axis=-1, keepdims=True)
+            d = d + (lsum * softmax - lab32) * gL32
+            d_label = (-(logits32 - lse) * gL32).astype(Label.dtype)
+        else:
+            ids = _squeeze_label(Label).astype(jnp.int32)
+            onehot = (ids[..., None]
+                      == jnp.arange(softmax.shape[-1], dtype=jnp.int32))
+            contrib = (softmax - onehot.astype(jnp.float32)) * gL32
+            ignore = ctx.attr("ignore_index", -100)
+            contrib = jnp.where(ids[..., None] == ignore, 0.0, contrib)
+            d = d + contrib
+    if gS is not None:
+        gS32 = gS.astype(jnp.float32)
+        inner = gS32 - jnp.sum(gS32 * softmax, axis=-1, keepdims=True)
+        d = d + softmax * inner
+    out = {"Logits": d.astype(Logits.dtype)}
+    if d_label is not None:
+        out["Label"] = d_label
+    return out
 
 
 @register_op("sigmoid_cross_entropy_with_logits")
