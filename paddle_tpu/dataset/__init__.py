@@ -7,6 +7,7 @@ every dataset falls back to a deterministic synthetic surrogate with the
 same sample schema, so pipelines and tests stay runnable."""
 
 from . import common  # noqa: F401
+from . import image  # noqa: F401
 from . import mnist  # noqa: F401
 from . import cifar  # noqa: F401
 from . import uci_housing  # noqa: F401

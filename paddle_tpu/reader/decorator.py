@@ -56,12 +56,16 @@ def compose(*readers, check_alignment=True):
     def reader():
         rs = [r() for r in readers]
         if check_alignment:
-            for outputs in zip(*rs):
-                yield sum((make_tuple(o) for o in outputs), ())
-        else:
+            # reference semantics (decorator.py:135): alignment CHECKED ->
+            # misaligned readers raise ComposeNotAligned
             for outputs in itertools.zip_longest(*rs):
                 if any(o is None for o in outputs):
-                    raise RuntimeError("readers have different lengths")
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum((make_tuple(o) for o in outputs), ())
+        else:
+            # unchecked: silently stop at the shortest reader
+            for outputs in zip(*rs):
                 yield sum((make_tuple(o) for o in outputs), ())
 
     return reader
@@ -189,3 +193,49 @@ def batch(reader, batch_size, drop_last=True):
             yield b
 
     return batch_reader
+
+
+class ComposeNotAligned(ValueError):
+    """Raised by compose(check_alignment=True) when input readers yield
+    different numbers of samples (reference decorator.py:114)."""
+
+
+class PipeReader:
+    """Stream records from a shell command's stdout (reference
+    decorator.py PipeReader): `PipeReader("cat f.txt").get_line()` yields
+    decoded lines; file_type="gzip" decompresses on the fly. The command
+    is run WITHOUT a shell (split argv), matching the reference."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        import subprocess
+        import zlib
+        if not isinstance(command, str):
+            raise TypeError("command must be a string")
+        if file_type == "gzip":
+            self.dec = zlib.decompressobj(32 + zlib.MAX_WBITS)
+        elif file_type != "plain":
+            raise TypeError(f"file_type {file_type} is not allowed")
+        self.file_type = file_type
+        self.bufsize = bufsize
+        self.process = subprocess.Popen(command.split(" "), bufsize=bufsize,
+                                        stdout=subprocess.PIPE)
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        remained = b""
+        lb = line_break.encode() if isinstance(line_break, str) else line_break
+        while True:
+            buff = self.process.stdout.read(self.bufsize)
+            if buff:
+                if self.file_type == "gzip":
+                    buff = self.dec.decompress(buff)
+                if cut_lines:
+                    lines = (remained + buff).split(lb)
+                    remained = lines.pop()
+                    for line in lines:
+                        yield line.decode(errors="replace")
+                else:
+                    yield buff
+            else:
+                break
+        if remained:
+            yield remained.decode(errors="replace")

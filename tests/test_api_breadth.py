@@ -380,3 +380,55 @@ def test_se_resnext_trains():
         vals.append(float(np.asarray(out).reshape(-1)[0]))
     assert all(np.isfinite(v) for v in vals)
     assert vals[-1] < vals[0], vals
+
+
+def test_reader_creator_and_pipe():
+    from paddle_tpu.reader import creator, ComposeNotAligned, PipeReader
+    from paddle_tpu.reader import decorator as dec
+
+    assert [int(e) for e in creator.np_array(np.arange(3))()] == [0, 1, 2]
+    assert [float(e) for e in creator.np_array(np.array(5.0))()] == [5.0]
+
+    bad = dec.compose(lambda: iter([1, 2]), lambda: iter([3]))
+    with pytest.raises(ComposeNotAligned):
+        list(bad())
+    ok = dec.compose(lambda: iter([1, 2]), lambda: iter([3]),
+                     check_alignment=False)
+    assert list(ok()) == [(1, 3)]
+
+    pr = PipeReader("echo pipe-works")
+    assert list(pr.get_line()) == ["pipe-works"]
+
+
+def test_reader_creator_recordio(tmp_path):
+    path = str(tmp_path / "c.recordio")
+
+    def reader():
+        for i in range(4):
+            yield (np.array([i], np.int64),)
+
+    fluid.convert_reader_to_recordio_file(path, reader)
+    from paddle_tpu.reader import creator
+    rows = list(creator.recordio(path)())
+    assert len(rows) == 4 and int(rows[2][0][0]) == 2
+
+
+def test_dataset_image_utils():
+    from paddle_tpu.dataset import image as pi
+    im = (np.random.RandomState(0).rand(40, 60, 3) * 255).astype(np.uint8)
+    s = pi.resize_short(im, 32)
+    assert min(s.shape[:2]) == 32
+    assert pi.center_crop(s, 24).shape[:2] == (24, 24)
+    assert pi.left_right_flip(im)[0, 0, 0] == im[0, -1, 0]
+    t = pi.simple_transform(im, 48, 32, is_train=False, mean=[1, 2, 3])
+    assert t.shape == (3, 32, 32) and t.dtype == np.float32
+    t2 = pi.load_image_bytes(_png_bytes())
+    assert t2.ndim == 3 and t2.shape[2] == 3
+
+
+def _png_bytes():
+    import io
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(np.zeros((8, 8, 3), np.uint8)).save(buf, format="PNG")
+    return buf.getvalue()

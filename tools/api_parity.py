@@ -55,6 +55,10 @@ MODULES = [
     ("default_scope_funcs.py", "default_scope_funcs"),
     ("recordio_writer.py", "recordio_writer"),
     ("concurrency.py", None),         # every export waived (retired)
+    # python/paddle top-level modules (outside fluid/)
+    ("../reader/decorator.py", "reader"),
+    ("../reader/creator.py", "reader.creator"),
+    ("../dataset/image.py", "dataset.image"),
 ]
 
 # Reference exports deliberately not re-implemented, with the decision of
