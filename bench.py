@@ -180,6 +180,43 @@ def bench_transformer(fluid, models, jax, seq_len, batch_size, fused,
     return tok_s, flops / dt
 
 
+def bench_stacked_lstm(fluid, models, jax, batch_size=64, seq_len=100,
+                       steps=10, warmup=3):
+    """Variable-length RNN path (BASELINE config "Stacked dynamic LSTM
+    LM"): 3x512 masked-scan LSTMs with peepholes over padded batches +
+    lengths, IMDB-shaped (seq 100, dict 30k — the reference's RNN
+    benchmark config, benchmark/README.md:111)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        feeds, outs = models.stacked_dynamic_lstm.build()
+        loss = outs["loss"]
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0), amp=True)
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    words = rng.randint(1, 30000, (batch_size, seq_len, 1)).astype(np.int64)
+    lens = rng.randint(seq_len // 2, seq_len + 1,
+                       (batch_size,)).astype(np.int32)
+    feed = {"words": (words, lens),
+            "label": rng.randint(0, 2, (batch_size, 1)).astype(np.int64)}
+    for _ in range(warmup):
+        out = exe.run(main, feed=feed, fetch_list=[loss],
+                      return_numpy=False, scope=scope)
+    _sync(out[0])
+
+    def window():
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = exe.run(main, feed=feed, fetch_list=[loss],
+                          return_numpy=False, scope=scope)
+        _sync(out[0])
+        return time.perf_counter() - t0
+
+    dt = sorted(window() for _ in range(3))[1] / steps
+    return batch_size * seq_len / dt, batch_size / dt
+
+
 def bench_feeder_overlap(fluid, jax, steps=25):
     """Like-for-like pair: the same conv model stepped from host numpy
     batches synchronously vs through the double-buffering AsyncFeeder
@@ -277,6 +314,7 @@ def main():
     flops_per_tok_2k = tf2k_fps / tok_long_unf if tok_long_unf else 0.0
     fus2k_fps = flops_per_tok_2k * tok_long_fus
     sync_ips, async_ips = bench_feeder_overlap(fluid, jax)
+    lstm_tok, lstm_ex = bench_stacked_lstm(fluid, models, jax)
 
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
@@ -295,6 +333,8 @@ def main():
             "feeder_sync_images_per_sec": round(sync_ips, 1),
             "feeder_async_images_per_sec": round(async_ips, 1),
             "feeder_h2d_overlap_speedup": round(async_ips / sync_ips, 2),
+            "stacked_lstm_tokens_per_sec": round(lstm_tok, 0),
+            "stacked_lstm_examples_per_sec": round(lstm_ex, 1),
         },
     }))
 

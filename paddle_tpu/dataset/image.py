@@ -20,7 +20,7 @@ __all__ = [
 ]
 
 
-def _decode(data_or_path, is_bytes):
+def _decode(data_or_path, is_bytes, is_color):
     try:
         from PIL import Image
     except ImportError as e:  # pragma: no cover
@@ -30,24 +30,27 @@ def _decode(data_or_path, is_bytes):
     import io
     src = io.BytesIO(data_or_path) if is_bytes else data_or_path
     with Image.open(src) as im:
-        return np.asarray(im.convert("RGB"))
+        rgb = np.asarray(im.convert("RGB"))
+    if not is_color:
+        # cv2's grayscale conversion (luminosity weights), reference parity
+        g = (0.299 * rgb[..., 0] + 0.587 * rgb[..., 1] + 0.114 * rgb[..., 2])
+        return np.clip(np.rint(g), 0, 255).astype(rgb.dtype)
+    # the reference decodes with cv2.imread -> BGR channel order; ported
+    # pipelines subtract BGR means / feed BGR-trained weights, so match it
+    return rgb[..., ::-1]
 
 
 def load_image_bytes(data, is_color=True):
-    """Decode an encoded image byte string to an HWC array (reference
-    image.py load_image_bytes)."""
-    img = _decode(data, True)
-    if not is_color:
-        img = img.mean(axis=2).astype(img.dtype)
-    return img
+    """Decode an encoded image byte string to an HWC array in the
+    reference's cv2 BGR channel order (reference image.py
+    load_image_bytes)."""
+    return _decode(data, True, is_color)
 
 
 def load_image(file, is_color=True):
-    """Load an image file to an HWC array (reference image.py load_image)."""
-    img = _decode(file, False)
-    if not is_color:
-        img = img.mean(axis=2).astype(img.dtype)
-    return img
+    """Load an image file to an HWC array in the reference's cv2 BGR
+    channel order (reference image.py load_image)."""
+    return _decode(file, False, is_color)
 
 
 def resize_short(im, size):

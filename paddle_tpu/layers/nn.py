@@ -78,15 +78,18 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
 
 
 def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
-                 bias_attr=None, use_peepholes=False, is_reverse=False,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
                  gate_activation="sigmoid", cell_activation="tanh",
                  candidate_activation="tanh", dtype="float32", name=None):
-    """LSTM over a variable-length batch (reference nn.py:293). `input` is the
-    x-projection [B, T, 4*size] (apply `fc` first, as in the reference)."""
+    """LSTM over a variable-length batch (reference nn.py:293, including
+    its use_peepholes=True default). `input` is the x-projection
+    [B, T, 4*size] (apply `fc` first, as in the reference). With peepholes
+    the bias packs [4H gate biases | W_ic | W_if | W_oc] (lstm_op.cc)."""
     helper = LayerHelper("lstm", **locals())
     hidden_size = size // 4
+    bias_cols = 7 * hidden_size if use_peepholes else 4 * hidden_size
     weight = helper.create_parameter(param_attr, [hidden_size, 4 * hidden_size], dtype)
-    bias = helper.create_parameter(helper.bias_attr, [1, 4 * hidden_size], dtype,
+    bias = helper.create_parameter(helper.bias_attr, [1, bias_cols], dtype,
                                    is_bias=True) if bias_attr is not False else None
     hidden = helper.create_variable_for_type_inference(dtype)
     cell = helper.create_variable_for_type_inference(dtype)
@@ -1089,19 +1092,21 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
 
 
 def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
-                  use_peepholes=False, is_reverse=False,
+                  use_peepholes=True, is_reverse=False,
                   gate_activation="sigmoid", cell_activation="tanh",
                   candidate_activation="tanh", proj_activation="tanh",
                   dtype="float32", name=None):
-    """LSTM with recurrent projection (reference nn.py dynamic_lstmp).
+    """LSTM with recurrent projection (reference nn.py dynamic_lstmp,
+    including its use_peepholes=True default).
     `input`: [B, T, 4*hidden] x-projections, as for dynamic_lstm."""
     helper = LayerHelper("lstmp", **locals())
     hidden_size = size // 4
+    bias_cols = 7 * hidden_size if use_peepholes else 4 * hidden_size
     weight = helper.create_parameter(param_attr,
                                      [proj_size, 4 * hidden_size], dtype)
     proj_weight = helper.create_parameter(param_attr,
                                           [hidden_size, proj_size], dtype)
-    bias = helper.create_parameter(helper.bias_attr, [1, 4 * hidden_size],
+    bias = helper.create_parameter(helper.bias_attr, [1, bias_cols],
                                    dtype, is_bias=True) \
         if bias_attr is not False else None
     proj = helper.create_variable_for_type_inference(dtype)

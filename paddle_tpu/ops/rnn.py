@@ -47,16 +47,28 @@ def _lstm(ctx, Input, Weight, Bias=None, H0=None, C0=None, SeqLen=None):
     gate_act = _ACTS[ctx.attr("gate_activation", "sigmoid")]
     cell_act = _ACTS[ctx.attr("cell_activation", "tanh")]
     cand_act = _ACTS[ctx.attr("candidate_activation", "tanh")]
-    if ctx.attr("use_peepholes", False):
-        raise NotImplementedError("peephole LSTM not supported on TPU path yet")
+    use_peep = ctx.attr("use_peepholes", False)
     B, T, H4 = Input.shape
     H = H4 // 4
     x = Input
     seqlen = SeqLen if SeqLen is not None else jnp.full((B,), T, jnp.int32)
     if ctx.attr("is_reverse", False):
         x = _reverse_padded(x, seqlen)
+    # peephole layout (reference lstm_op.cc): Bias [1, 7H] packs the 4H
+    # gate biases then the diagonal peephole weights W_ic, W_if, W_oc —
+    # elementwise cell taps on the i/f gates (c_prev) and o gate (c_new)
+    w_ic = w_if = w_oc = None
+    if use_peep and Bias is None:
+        raise ValueError(
+            "use_peepholes=True needs the fused [1,7H] bias tensor (it "
+            "carries W_ic/W_if/W_oc); pass a bias or use_peepholes=False")
     if Bias is not None:
-        x = x + Bias.reshape(1, 1, H4)
+        b = Bias.reshape(-1)
+        x = x + b[: 4 * H].reshape(1, 1, 4 * H)
+        if use_peep:
+            w_ic = b[4 * H:5 * H]
+            w_if = b[5 * H:6 * H]
+            w_oc = b[6 * H:7 * H]
     h0 = H0 if H0 is not None else jnp.zeros((B, H), Input.dtype)
     c0 = C0 if C0 is not None else jnp.zeros((B, H), Input.dtype)
     mask = (jnp.arange(T)[None, :] < seqlen.reshape(-1, 1)).astype(Input.dtype)  # [B,T]
@@ -69,9 +81,15 @@ def _lstm(ctx, Input, Weight, Bias=None, H0=None, C0=None, SeqLen=None):
         xt, m = inp
         gates = xt + h @ Weight
         i, f, g, o = jnp.split(gates, 4, axis=-1)
-        i, f, o = gate_act(i), gate_act(f), gate_act(o)
+        if w_ic is not None:
+            i = i + w_ic * c
+            f = f + w_if * c
+        i, f = gate_act(i), gate_act(f)
         g = cand_act(g)
         c_new = f * c + i * g
+        if w_oc is not None:
+            o = o + w_oc * c_new
+        o = gate_act(o)
         h_new = o * cell_act(c_new)
         c_keep = m * c_new + (1.0 - m) * c
         h_keep = m * h_new + (1.0 - m) * h
@@ -165,8 +183,7 @@ def _lstmp(ctx, Input, Weight, ProjWeight, Bias=None, H0=None, C0=None,
     cell_act = _ACTS[ctx.attr("cell_activation", "tanh")]
     cand_act = _ACTS[ctx.attr("candidate_activation", "tanh")]
     proj_act = _ACTS[ctx.attr("proj_activation", "tanh")]
-    if ctx.attr("use_peepholes", False):
-        raise NotImplementedError("peephole LSTMP not supported on TPU path yet")
+    use_peep = ctx.attr("use_peepholes", False)
     B, T, H4 = Input.shape
     H = H4 // 4
     P = ProjWeight.shape[1]
@@ -174,8 +191,18 @@ def _lstmp(ctx, Input, Weight, ProjWeight, Bias=None, H0=None, C0=None,
     seqlen = SeqLen if SeqLen is not None else jnp.full((B,), T, jnp.int32)
     if ctx.attr("is_reverse", False):
         x = _reverse_padded(x, seqlen)
+    w_ic = w_if = w_oc = None
+    if use_peep and Bias is None:
+        raise ValueError(
+            "use_peepholes=True needs the fused [1,7H] bias tensor (it "
+            "carries W_ic/W_if/W_oc); pass a bias or use_peepholes=False")
     if Bias is not None:
-        x = x + Bias.reshape(1, 1, H4)
+        b = Bias.reshape(-1)
+        x = x + b[: 4 * H].reshape(1, 1, 4 * H)
+        if use_peep:       # [1,7H] layout, see _lstm
+            w_ic = b[4 * H:5 * H]
+            w_if = b[5 * H:6 * H]
+            w_oc = b[6 * H:7 * H]
     r0 = H0 if H0 is not None else jnp.zeros((B, P), Input.dtype)
     c0 = C0 if C0 is not None else jnp.zeros((B, H), Input.dtype)
     mask = (jnp.arange(T)[None, :] < seqlen.reshape(-1, 1)).astype(Input.dtype)
@@ -188,8 +215,14 @@ def _lstmp(ctx, Input, Weight, ProjWeight, Bias=None, H0=None, C0=None,
         xt, m = inp
         gates = xt + r @ Weight
         i, f, g, o = jnp.split(gates, 4, axis=-1)
-        i, f, o = gate_act(i), gate_act(f), gate_act(o)
+        if w_ic is not None:
+            i = i + w_ic * c
+            f = f + w_if * c
+        i, f = gate_act(i), gate_act(f)
         c_new = f * c + i * cand_act(g)
+        if w_oc is not None:
+            o = o + w_oc * c_new
+        o = gate_act(o)
         h_new = o * cell_act(c_new)
         r_new = proj_act(h_new @ ProjWeight)
         c_keep = m * c_new + (1.0 - m) * c

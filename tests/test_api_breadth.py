@@ -432,3 +432,89 @@ def _png_bytes():
     buf = io.BytesIO()
     Image.fromarray(np.zeros((8, 8, 3), np.uint8)).save(buf, format="PNG")
     return buf.getvalue()
+
+
+def test_peephole_lstm_matches_numpy():
+    """dynamic_lstm with use_peepholes=True (the reference default, now
+    supported): forward against a hand-rolled numpy recurrence, gradient
+    against finite differences through the whole program."""
+    B, T, H = 2, 5, 3
+    rng = np.random.RandomState(0)
+    xb = rng.randn(B, T, 4 * H).astype(np.float32) * 0.5
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="x", shape=[-1, T, 4 * H], dtype="float32",
+                        append_batch_size=False)
+        h, c = layers.dynamic_lstm(input=x, size=4 * H, use_peepholes=True)
+        loss = layers.mean(h)
+        params_grads = fluid.append_backward(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    wname = next(p.name for p, _ in params_grads if p.shape == (H, 4 * H))
+    bname = next(p.name for p, _ in params_grads if p.shape == (1, 7 * H))
+    W = np.asarray(scope.find_var(wname))
+    bias = np.asarray(scope.find_var(bname)).reshape(-1)
+
+    hv, lv, gw = exe.run(main, feed={"x": xb},
+                         fetch_list=[h, loss, wname + "@GRAD"], scope=scope)
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    b4, w_ic, w_if, w_oc = (bias[:4 * H], bias[4 * H:5 * H],
+                            bias[5 * H:6 * H], bias[6 * H:7 * H])
+    ref = np.zeros((B, T, H), np.float32)
+    hs, cs = np.zeros((B, H)), np.zeros((B, H))
+    for t in range(T):
+        g = xb[:, t] + b4 + hs @ W
+        i, f, gg, o = np.split(g, 4, axis=-1)
+        i = sig(i + w_ic * cs)
+        f = sig(f + w_if * cs)
+        cn = f * cs + i * np.tanh(gg)
+        o = sig(o + w_oc * cn)
+        hs, cs = o * np.tanh(cn), cn
+        ref[:, t] = hs
+    np.testing.assert_allclose(np.asarray(hv), ref, rtol=1e-5, atol=1e-5)
+
+    # FD check on one weight entry
+    eps = 1e-3
+    Wp = W.copy(); Wp[0, 0] += eps
+    scope.set_var(wname, Wp)
+    _, lp, _ = exe.run(main, feed={"x": xb},
+                       fetch_list=[h, loss, wname + "@GRAD"], scope=scope)
+    Wm = W.copy(); Wm[0, 0] -= eps
+    scope.set_var(wname, Wm)
+    _, lm, _ = exe.run(main, feed={"x": xb},
+                       fetch_list=[h, loss, wname + "@GRAD"], scope=scope)
+    fd = (float(np.asarray(lp)) - float(np.asarray(lm))) / (2 * eps)
+    np.testing.assert_allclose(float(np.asarray(gw)[0, 0]), fd,
+                               rtol=2e-2, atol=1e-4)
+
+
+def test_image_bgr_order_and_peephole_guard():
+    """load_image* returns cv2-parity BGR; peepholes without a bias raise."""
+    import io
+    from PIL import Image
+    from paddle_tpu.dataset import image as pi
+    arr = np.zeros((4, 4, 3), np.uint8)
+    arr[..., 0] = 200  # red in RGB
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    got = pi.load_image_bytes(buf.getvalue())
+    assert got[0, 0, 2] == 200 and got[0, 0, 0] == 0, "expected BGR order"
+    gray = pi.load_image_bytes(buf.getvalue(), is_color=False)
+    assert gray.ndim == 2 and abs(int(gray[0, 0]) - round(0.299 * 200)) <= 1
+
+    with pytest.raises(ValueError, match="peephole"):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = layers.data(name="x", shape=[-1, 5, 16], dtype="float32",
+                            append_batch_size=False)
+            layers.dynamic_lstm(input=x, size=16, bias_attr=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        exe.run(main, feed={"x": np.zeros((2, 5, 16), np.float32)},
+                fetch_list=[], scope=scope)
