@@ -139,3 +139,29 @@ def test_transformer_fused_attention_trains():
         losses.append(float(np.asarray(out).reshape(-1)[0]))
     assert all(np.isfinite(l) for l in losses)
     assert np.mean(losses[-3:]) < np.mean(losses[:3])
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_kernel_multiblock_streaming(interpret_kernels, causal):
+    """T=1024 at block 512 = multiple innermost-grid steps: exercises the
+    scratch-carried online softmax across kj iterations, the kj==0 init /
+    kj==nk-1 finalize split, and the causal live-block skip — all of
+    which degenerate to a single no-op step at T=256."""
+    rng = np.random.RandomState(1)
+    B, H, T, D = 1, 2, 1024, 64
+    q, k, v = (jnp.asarray(rng.randn(B, H, T, D) * 0.2, jnp.float32)
+               for _ in range(3))
+    seed = jnp.int32(0)
+
+    out = flash_attention(q, k, v, seed, causal, D ** -0.5, 0.0)
+    ref = _attention_reference(q, k, v, causal, D ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
+
+    g = jax.grad(lambda q, k, v: flash_attention(
+        q, k, v, seed, causal, D ** -0.5, 0.0).sum(), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: _attention_reference(
+        q, k, v, causal, D ** -0.5).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
