@@ -82,6 +82,21 @@ _HASH_A = int(np.int32(np.uint32(2654435761)))
 _HASH_B = 40503
 
 
+def _causal_live(qi, kj, blk_q, blk_k):
+    """Whether the (qi, kj) block intersects the causal lower triangle.
+    Shared by all three kernels — block coverage and dropout-mask seeding
+    are keyed to the same (qi, kj) indices, so the fwd/dQ/dKV predicates
+    must be structurally identical."""
+    return kj * blk_k <= qi * blk_q + blk_q - 1
+
+
+def _apply_causal_mask(s, qi, kj, blk_q, blk_k):
+    """Mask strictly-above-diagonal entries of one score tile."""
+    row = qi * blk_q + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+    col = kj * blk_k + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+    return jnp.where(col > row, NEG_INF, s)
+
+
 def _dropout_mask(seed_ref, bh, qi, kj, shape, rate):
     """Deterministic keep-mask for one (bh, q-block, k-block) tile. Re-seeding
     per tile makes the mask independent of kernel iteration order, so the
@@ -121,7 +136,7 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         acc_sc[...] = jnp.zeros_like(acc_sc)
 
     # causal: blocks entirely above the diagonal contribute nothing
-    live = (kj * blk_k <= qi * blk_q + blk_q - 1) if causal else True
+    live = _causal_live(qi, kj, blk_q, blk_k) if causal else True
 
     @pl.when(live)
     def _update():
@@ -131,9 +146,7 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
         if causal:
-            row = qi * blk_q + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
-            col = kj * blk_k + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
-            s = jnp.where(col > row, NEG_INF, s)
+            s = _apply_causal_mask(s, qi, kj, blk_q, blk_k)
         m = m_sc[...]
         l = l_sc[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
@@ -174,7 +187,7 @@ def _flash_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     def _init():
         dq_sc[...] = jnp.zeros_like(dq_sc)
 
-    live = (kj * blk_k <= qi * blk_q + blk_q - 1) if causal else True
+    live = _causal_live(qi, kj, blk_q, blk_k) if causal else True
 
     @pl.when(live)
     def _update():
@@ -187,9 +200,7 @@ def _flash_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
         if causal:
-            row = qi * blk_q + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
-            col = kj * blk_k + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
-            s = jnp.where(col > row, NEG_INF, s)
+            s = _apply_causal_mask(s, qi, kj, blk_q, blk_k)
         w = jnp.exp(s - lse[:, None])                  # normalized weights
         dpv = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                               preferred_element_type=jnp.float32)
@@ -230,7 +241,7 @@ def _flash_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dv_sc[...] = jnp.zeros_like(dv_sc)
 
     # causal: q blocks strictly above this k block see none of it
-    live = (qi * blk_q + blk_q - 1 >= kj * blk_k) if causal else True
+    live = _causal_live(qi, kj, blk_q, blk_k) if causal else True
 
     @pl.when(live)
     def _update():
@@ -243,9 +254,7 @@ def _flash_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
         if causal:
-            row = qi * blk_q + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
-            col = kj * blk_k + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
-            s = jnp.where(col > row, NEG_INF, s)
+            s = _apply_causal_mask(s, qi, kj, blk_q, blk_k)
         w = jnp.exp(s - lse[:, None])                  # [blk_q, blk_k]
         dpv = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                               preferred_element_type=jnp.float32)
