@@ -112,11 +112,20 @@ def _bilinear_interp(ctx, X, OutSize=None):
 
 @register_op("crop", propagate_seqlen=False)
 def _crop(ctx, X, Y=None, Offsets=None):
-    """Static crop (reference crop_op.cc): shape from attr or Y's shape."""
+    """Crop (reference crop_op.cc): output shape from attr or Y's shape
+    (static); offsets from the attr or a runtime Offsets tensor — dynamic
+    STARTS are a lax.dynamic_slice, fully XLA-legal."""
     shape = ctx.attr("shape") or (list(Y.shape) if Y is not None else None)
-    offsets = ctx.attr("offsets") or [0] * X.ndim
     if Offsets is not None:
-        raise NotImplementedError("tensor Offsets: pass the offsets attr")
+        flat = Offsets.reshape(-1)
+        if flat.shape[0] != X.ndim:   # reference enforces size == rank
+            raise ValueError(
+                f"crop: Offsets has {flat.shape[0]} elements for a "
+                f"{X.ndim}-D input; one offset per dimension is required")
+        starts = [flat[i].astype(jnp.int32) for i in range(X.ndim)]
+        return {"Out": lax.dynamic_slice(X, starts,
+                                         [int(s) for s in shape])}
+    offsets = ctx.attr("offsets") or [0] * X.ndim
     return {"Out": lax.slice(X, [int(o) for o in offsets],
                              [int(o) + int(s) for o, s in zip(offsets, shape)])}
 

@@ -105,9 +105,22 @@ def _sequence_concat(ctx, X):
 
 @register_op("sequence_slice", propagate_seqlen=False)
 def _sequence_slice(ctx, X, Offset, Length):
-    off = int(Offset.reshape(-1)[0]) if not hasattr(Offset, "aval") else Offset
-    raise NotImplementedError("sequence_slice requires static offsets on TPU; "
-                              "use layers.slice instead")
+    """Per-sequence sub-slices (reference sequence_slice_op.cc): row b of
+    the output is X[b, off_b : off_b + len_b], left-aligned in the padded
+    [B, T, ...] layout with OutLen = len_b. Dynamic STARTS are fine under
+    XLA (a gather); only dynamic shapes are not — the old raise conflated
+    the two."""
+    B, T = X.shape[0], X.shape[1]
+    off = Offset.reshape(B).astype(jnp.int32)
+    ln = Length.reshape(B).astype(jnp.int32)
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]
+    idx = jnp.clip(off[:, None] + t, 0, T - 1)          # [B, T]
+    gidx = idx.reshape((B, T) + (1,) * (X.ndim - 2))
+    out = jnp.take_along_axis(
+        X, jnp.broadcast_to(gidx, (B, T) + X.shape[2:]), axis=1)
+    mask = (t < ln[:, None]).reshape((B, T) + (1,) * (X.ndim - 2))
+    out = jnp.where(mask, out, jnp.zeros((), out.dtype))
+    return {"Out": out, "OutLen": ln}
 
 
 @register_op("sequence_conv", propagate_seqlen=False)
@@ -134,8 +147,29 @@ def _sequence_conv(ctx, X, Filter, SeqLen=None, PaddingData=None):
 
 @register_op("sequence_erase", propagate_seqlen=False)
 def _sequence_erase(ctx, X, SeqLen=None):
-    raise NotImplementedError(
-        "sequence_erase changes lengths dynamically; preprocess on host instead")
+    """Remove the attr `tokens` from each sequence and compact left
+    (reference sequence_erase_op.cc). Static-shape stream compaction: a
+    STABLE argsort of the drop mask moves kept entries to the front in
+    order; OutLen carries the shrunken lengths. The output stays padded
+    [B, T] — the 'dynamic length' the old raise pointed at lives in the
+    lengths companion, exactly like every other sequence op here."""
+    tokens = [int(v) for v in (ctx.attr("tokens", []) or [])]
+    squeeze = X.ndim == 3 and X.shape[-1] == 1   # Paddle ids are often [B,T,1]
+    ids = X.reshape(X.shape[0], X.shape[1]) if squeeze else X
+    B, T = ids.shape
+    L = (SeqLen.reshape(-1) if SeqLen is not None
+         else jnp.full((B,), T, jnp.int32))      # tolerate [B] or [B,1]
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]
+    keep = t < L[:, None]
+    for tok in tokens:
+        keep = keep & (ids != tok)
+    new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    order = jnp.argsort(~keep, axis=1, stable=True)     # kept first, in order
+    compacted = jnp.take_along_axis(ids, order, axis=1)
+    out = jnp.where(t < new_len[:, None], compacted, jnp.zeros((), ids.dtype))
+    if squeeze:
+        out = out[..., None]
+    return {"Out": out, "OutLen": new_len}
 
 
 @register_op("sequence_expand_as", propagate_seqlen=False)

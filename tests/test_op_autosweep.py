@@ -553,8 +553,8 @@ WAIVED = {
     "tile_beam": "beam plumbing; tests/test_machine_translation.py",
     "fused_attention": "pallas kernel; tests/test_flash_attention.py",
     "auc": "stateful metric accumulators; tests/test_smoke.py metrics",
-    "sequence_slice": "raises by design (static-shape limit documented)",
-    "sequence_erase": "raises by design (dynamic lengths; host preprocess)",
+    "sequence_slice": "padded-slice vs numpy; tests/test_api_breadth.py",
+    "sequence_erase": "stable-sort compaction; tests/test_api_breadth.py",
     "prior_box": "value-checked vs hand math; tests/test_detection.py",
     "anchor_generator": "prior_box sibling; tests/test_detection.py",
     "box_coder": "encode/decode roundtrip; tests/test_detection.py",
