@@ -240,7 +240,7 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
            global_pooling=False, use_cudnn=True, ceil_mode=False,
-           exclusive=True, name=None, data_format="NCHW"):
+           exclusive=True, name=None, data_format="NCHW", adaptive=False):
     helper = LayerHelper("pool2d", **locals())
     out = helper.create_variable_for_type_inference(dtype=input.dtype)
     helper.append_op("pool2d", inputs={"X": [input.name]},
@@ -249,7 +249,7 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
                             "strides": _pair(pool_stride),
                             "paddings": _pair(pool_padding),
                             "global_pooling": global_pooling,
-                            "exclusive": exclusive,
+                            "exclusive": exclusive, "adaptive": adaptive,
                             "data_format": data_format})
     return out
 
@@ -1195,3 +1195,45 @@ def beam_search_decode(ids_hist, parents_hist, final_scores, beam_size=None,
                               "SentenceScores": [scores.name]})
     blk = helper.main_program.current_block()
     return ids, scores
+
+
+def sequence_slice(input, offset, length, name=None):
+    """Per-sequence sub-slices (reference sequence_slice_op.cc): row b of
+    the output is input[b, offset_b : offset_b + length_b], left-aligned
+    in the padded layout; the slice lengths ride the @SEQLEN companion.
+    Runtime lengths clamp to the padded bound (an XLA program cannot
+    raise on traced values; the reference host-asserts instead)."""
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    lens = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op("sequence_slice",
+                     inputs={"X": [input.name], "Offset": [offset.name],
+                             "Length": [length.name]},
+                     outputs={"Out": [out.name], "OutLen": [lens.name]})
+    out.lod_level = max(input.lod_level, 1)
+    blk = helper.main_program.current_block()
+    comp = blk.create_var(name=seqlen_var_name(out.name), shape=[-1],
+                          dtype="int32")
+    helper.append_op("assign", inputs={"X": [lens.name]},
+                     outputs={"Out": [comp.name]})
+    return out
+
+
+def sequence_erase(input, tokens, name=None):
+    """Remove `tokens` from each sequence and compact left (reference
+    sequence_erase_op.cc; used by edit_distance preprocessing). The
+    shrunken lengths ride the @SEQLEN companion."""
+    helper = LayerHelper("sequence_erase", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    lens = helper.create_variable_for_type_inference(dtype="int32")
+    inputs = _seq_inputs(helper, input)
+    helper.append_op("sequence_erase", inputs=inputs,
+                     outputs={"Out": [out.name], "OutLen": [lens.name]},
+                     attrs={"tokens": [int(t) for t in tokens]})
+    out.lod_level = max(input.lod_level, 1)
+    blk = helper.main_program.current_block()
+    comp = blk.create_var(name=seqlen_var_name(out.name), shape=[-1],
+                          dtype="int32")
+    helper.append_op("assign", inputs={"X": [lens.name]},
+                     outputs={"Out": [comp.name]})
+    return out

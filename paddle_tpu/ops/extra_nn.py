@@ -123,6 +123,11 @@ def _crop(ctx, X, Y=None, Offsets=None):
                 f"crop: Offsets has {flat.shape[0]} elements for a "
                 f"{X.ndim}-D input; one offset per dimension is required")
         starts = [flat[i].astype(jnp.int32) for i in range(X.ndim)]
+        # NOTE divergence from the static-offsets branch: runtime offsets
+        # that overflow CLAMP to the valid range (lax.dynamic_slice
+        # semantics — a compiled program cannot raise on traced values);
+        # the reference host-asserts offsets+shape <= dims. Validate on
+        # the host when offsets come from untrusted input.
         return {"Out": lax.dynamic_slice(X, starts,
                                          [int(s) for s in shape])}
     offsets = ctx.attr("offsets") or [0] * X.ndim

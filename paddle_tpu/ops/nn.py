@@ -97,11 +97,33 @@ def _pool2d(ctx, X):
     fmt = ctx.attr("data_format", "NCHW")
     spatial = (2, 3) if fmt == "NCHW" else (1, 2)
     if ctx.attr("global_pooling", False) or ctx.attr("adaptive", False):
-        if ctx.attr("adaptive", False) and tuple(ctx.attr("ksize")) != (1, 1):
-            raise NotImplementedError("adaptive pool2d only supports output 1x1")
-        if ptype == "max":
-            return {"Out": jnp.max(X, axis=spatial, keepdims=True)}
-        return {"Out": jnp.mean(X, axis=spatial, keepdims=True)}
+        oh, ow = ksize if ctx.attr("adaptive", False) else (1, 1)
+        h, w = X.shape[spatial[0]], X.shape[spatial[1]]
+        if ctx.attr("adaptive", False) and (oh < 1 or ow < 1):
+            raise ValueError(
+                "adaptive pool2d needs an explicit positive pool_size "
+                f"(the output grid); got {(oh, ow)}")
+        if (oh, ow) == (1, 1):
+            if ptype == "max":
+                return {"Out": jnp.max(X, axis=spatial, keepdims=True)}
+            return {"Out": jnp.mean(X, axis=spatial, keepdims=True)}
+        # adaptive to (oh, ow): exact when the output divides the input —
+        # each output cell reduces an equal (h/oh, w/ow) tile (the
+        # reference's bin boundaries coincide in that case)
+        if h % oh or w % ow:
+            raise NotImplementedError(
+                f"adaptive pool2d: output {(oh, ow)} must divide input "
+                f"{(h, w)} on TPU (unequal bins need ragged windows)")
+        if fmt == "NCHW":
+            n, c = X.shape[0], X.shape[1]
+            tiles = X.reshape(n, c, oh, h // oh, ow, w // ow)
+            red_axes = (3, 5)
+        else:
+            n, c = X.shape[0], X.shape[3]
+            tiles = X.reshape(n, oh, h // oh, ow, w // ow, c)
+            red_axes = (2, 4)
+        red = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": red(tiles, axis=red_axes)}
     if fmt == "NCHW":
         window = (1, 1) + ksize
         strides4 = (1, 1) + strides

@@ -112,7 +112,12 @@ def _sequence_slice(ctx, X, Offset, Length):
     the two."""
     B, T = X.shape[0], X.shape[1]
     off = Offset.reshape(B).astype(jnp.int32)
-    ln = Length.reshape(B).astype(jnp.int32)
+    # lengths clamp to the tensor bound: a compiled XLA program cannot
+    # raise on runtime values (the reference kernel host-asserts
+    # offset+length <= seqlen), and clamping beats the silent
+    # last-timestep duplication an unclamped gather would produce
+    ln = jnp.minimum(Length.reshape(B).astype(jnp.int32),
+                     jnp.maximum(T - off, 0))
     t = jnp.arange(T, dtype=jnp.int32)[None, :]
     idx = jnp.clip(off[:, None] + t, 0, T - 1)          # [B, T]
     gidx = idx.reshape((B, T) + (1,) * (X.ndim - 2))

@@ -569,3 +569,61 @@ def test_sequence_slice_and_erase_ops():
     assert float(jnp.max(g)) <= 1.0 + 1e-6
     assert abs(float(jnp.sum(g)) - 2.0 * float(jnp.sum(ln))) < 1e-4
 
+
+
+def test_adaptive_pool2d_divisible():
+    """adaptive pool2d beyond 1x1: exact tile reduction when the output
+    grid divides the input (checked against numpy in both layouts)."""
+    x = np.random.RandomState(0).rand(2, 3, 8, 12).astype(np.float32)
+
+    def build():
+        v = layers.data(name="x", shape=[-1, 3, 8, 12], dtype="float32",
+                        append_batch_size=False)
+        out = layers.pool2d(input=v, pool_type="avg", pool_size=[2, 3],
+                            adaptive=True)
+        return [out]
+
+    (got,) = run_prog(build, feed={"x": x})
+    ref = x.reshape(2, 3, 2, 4, 3, 4).mean(axis=(3, 5))
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5)
+
+
+def test_sequence_slice_erase_layers_companion_flow():
+    """The layers wrappers wire OutLen into the @SEQLEN companion, so a
+    downstream sequence_pool averages over the SHRUNKEN lengths, not the
+    padded tail."""
+    def build():
+        x = layers.data(name="x", shape=[1], dtype="int64", lod_level=1)
+        cleaned = layers.sequence_erase(x, tokens=[0])
+        emb = layers.embedding(input=cleaned, size=[16, 4])
+        pooled = layers.sequence_pool(input=emb, pool_type="average")
+        return [cleaned, pooled]
+
+    ids = np.array([[3, 0, 5, 0], [2, 4, 0, 0]], np.int64)[..., None]
+    lens = np.array([4, 3], np.int32)
+    cleaned, pooled = run_prog(build, feed={"x": (ids, lens)})
+    got = np.asarray(cleaned).reshape(2, 4)
+    np.testing.assert_array_equal(got[0, :2], [3, 5])
+    np.testing.assert_array_equal(got[1, :2], [2, 4])
+
+    def build2():
+        x = layers.data(name="x", shape=[-1, 5, 2], dtype="float32",
+                        append_batch_size=False, lod_level=1)
+        off = layers.data(name="off", shape=[-1, 1], dtype="int32",
+                          append_batch_size=False)
+        ln = layers.data(name="ln", shape=[-1, 1], dtype="int32",
+                         append_batch_size=False)
+        sl = layers.sequence_slice(x, off, ln)
+        pooled = layers.sequence_pool(input=sl, pool_type="sum")
+        return [sl, pooled]
+
+    xv = np.arange(20, dtype=np.float32).reshape(2, 5, 2)
+    off = np.array([[1], [0]], np.int32)
+    ln = np.array([[2], [3]], np.int32)
+    sl, pooled = run_prog(build2, feed={"x": (xv, np.array([5, 5], np.int32)),
+                                        "off": off, "ln": ln})
+    # sum pool over the slice lengths only
+    np.testing.assert_allclose(np.asarray(pooled)[0], xv[0, 1:3].sum(0),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pooled)[1], xv[1, 0:3].sum(0),
+                               rtol=1e-6)
