@@ -27,12 +27,22 @@ _MAP = {
 class _AliasLoader(importlib.abc.Loader):
     def __init__(self, real_name):
         self._real = real_name
+        self._orig = None
 
     def create_module(self, spec):
-        return importlib.import_module(self._real)  # the existing module
+        module = importlib.import_module(self._real)  # the existing module
+        # module_from_spec is about to stamp the alias spec/loader onto
+        # this already-initialized module; remember its real identity
+        self._orig = (getattr(module, "__spec__", None),
+                      getattr(module, "__loader__", None))
+        return module
 
     def exec_module(self, module):
-        pass  # already executed under its real name
+        # already executed under its real name — just restore identity so
+        # paddle_tpu.layers never claims to be paddle.fluid.layers (which
+        # would break importlib.reload and spec-based introspection)
+        if self._orig is not None:
+            module.__spec__, module.__loader__ = self._orig
 
 
 class _AliasFinder(importlib.abc.MetaPathFinder):

@@ -74,5 +74,19 @@ def np_dtype(dtype) -> np.dtype:
     return jnp.dtype(_DTYPES[canonical_dtype(dtype)])
 
 
+def index_dtype():
+    """Runtime dtype backing the reference's int64 index contract.
+
+    Under JAX's default x32 mode int64 arrays do not exist: an
+    ``astype(int64)`` silently produces int32 (plus a user warning). Ops
+    that declare int64 outputs for reference parity therefore cast through
+    this helper — int32 in x32 mode (documented downcast), widening to
+    real int64 only when ``jax_enable_x64`` is set.
+    """
+    import jax
+
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+
 def is_float_dtype(dtype) -> bool:
     return canonical_dtype(dtype) in FLOAT_DTYPES

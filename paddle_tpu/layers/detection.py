@@ -169,15 +169,25 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
     for i, feat in enumerate(inputs):
         mins = min_sizes[i]
         maxs = max_sizes[i] if max_sizes else None
+        mins_list = list(mins) if isinstance(mins, (list, tuple)) else [mins]
+        if maxs is not None:
+            maxs_list = (list(maxs) if isinstance(maxs, (list, tuple))
+                         else [maxs])
+            # prior_box pairs max_sizes[s] with min_sizes[s]; a length
+            # mismatch would mis-split the loc/conf conv channels
+            if len(maxs_list) != len(mins_list):
+                raise ValueError(
+                    "multi_box_head: layer %d supplies %d min_sizes but %d "
+                    "max_sizes; they must pair one-to-one"
+                    % (i, len(mins_list), len(maxs_list)))
+        else:
+            maxs_list = None
         ars = aspect_ratios[i] if isinstance(aspect_ratios[i], (list, tuple)) \
             else [aspect_ratios[i]]
         st = steps[i] if steps else [
             step_w[i] if step_w else 0.0, step_h[i] if step_h else 0.0]
         box, var = prior_box(
-            feat, image, [mins] if not isinstance(mins, (list, tuple)) else
-            list(mins),
-            [maxs] if maxs and not isinstance(maxs, (list, tuple)) else
-            (list(maxs) if maxs else None),
+            feat, image, mins_list, maxs_list,
             ars, variance, flip, clip,
             st if isinstance(st, (list, tuple)) else [st, st], offset,
             min_max_aspect_ratios_order=min_max_aspect_ratios_order)
@@ -185,8 +195,7 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
         # split to line up — use the op's own expansion, never a copy
         from ..ops.detection import _expand_aspect_ratios
         expanded = _expand_aspect_ratios(ars, flip)
-        mins_list = mins if isinstance(mins, (list, tuple)) else [mins]
-        num_priors = (len(expanded) + (1 if maxs else 0)) * len(mins_list)
+        num_priors = (len(expanded) + (1 if maxs_list else 0)) * len(mins_list)
         loc = _nn.conv2d(input=feat, num_filters=num_priors * 4,
                          filter_size=kernel_size, padding=pad, stride=stride)
         loc = _layers.transpose(loc, perm=[0, 2, 3, 1])

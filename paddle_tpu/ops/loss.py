@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.registry import register_op, register_grad
+from ..core import types
 
 
 def _squeeze_label(Label):
@@ -174,7 +175,7 @@ def _hinge_loss(ctx, Logits, Labels):
 @register_op("accuracy", propagate_seqlen=False)
 def _accuracy(ctx, Out, Indices, Label):
     """Top-k accuracy (reference accuracy_op.cc): Indices [N,k] from top_k."""
-    label = _squeeze_label(Label).astype(jnp.int64)
+    label = _squeeze_label(Label).astype(types.index_dtype())
     correct = jnp.any(Indices == label[:, None], axis=1)
     num_correct = jnp.sum(correct.astype(jnp.int32))
     total = jnp.int32(label.shape[0])

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.registry import register_op
+from ..core import types
 
 
 # ---------------------------------------------------------------------------
@@ -190,11 +191,11 @@ def _crf_decoding(ctx, Emission, Transition, Label=None, SeqLen=None):
     else:
         path = last_tag[:, None]
     path = (path * mask.astype(jnp.int32))
-    out = {"ViterbiPath": path.astype(jnp.int64)}
+    out = {"ViterbiPath": path.astype(types.index_dtype())}
     if Label is not None:
         lbl = Label[..., 0] if Label.ndim == 3 else Label
         out["ViterbiPath"] = ((path != lbl.astype(jnp.int32)) *
-                              mask.astype(jnp.int32)).astype(jnp.int64)
+                              mask.astype(jnp.int32)).astype(types.index_dtype())
     return out
 
 
@@ -308,4 +309,4 @@ def _edit_distance(ctx, Hyps, Refs, HypsLen=None, RefsLen=None):
     dist = jnp.take_along_axis(final, rl[:, None], axis=1)[:, 0]
     if normalized:
         dist = dist / jnp.maximum(rl.astype(jnp.float32), 1.0)
-    return {"Out": dist[:, None], "SequenceNum": jnp.array([B], jnp.int64)}
+    return {"Out": dist[:, None], "SequenceNum": jnp.array([B], types.index_dtype())}

@@ -238,17 +238,17 @@ def _cumsum(ctx, X):
 @register_op("top_k", propagate_seqlen=False)
 def _top_k(ctx, X):
     vals, idx = lax.top_k(X, ctx.attr("k", 1))
-    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+    return {"Out": vals, "Indices": idx.astype(types.index_dtype())}
 
 
 @register_op("arg_max", propagate_seqlen=False)
 def _arg_max(ctx, X):
-    return {"Out": jnp.argmax(X, axis=ctx.attr("axis", -1)).astype(jnp.int64)}
+    return {"Out": jnp.argmax(X, axis=ctx.attr("axis", -1)).astype(types.index_dtype())}
 
 
 @register_op("arg_min", propagate_seqlen=False)
 def _arg_min(ctx, X):
-    return {"Out": jnp.argmin(X, axis=ctx.attr("axis", -1)).astype(jnp.int64)}
+    return {"Out": jnp.argmin(X, axis=ctx.attr("axis", -1)).astype(types.index_dtype())}
 
 
 # -- comparisons / logicals (reference compare_op.cc, logical_op.cc) --------

@@ -111,13 +111,14 @@ def _sequence_slice(ctx, X, Offset, Length):
     XLA (a gather); only dynamic shapes are not — the old raise conflated
     the two."""
     B, T = X.shape[0], X.shape[1]
-    off = Offset.reshape(B).astype(jnp.int32)
-    # lengths clamp to the tensor bound: a compiled XLA program cannot
-    # raise on runtime values (the reference kernel host-asserts
-    # offset+length <= seqlen), and clamping beats the silent
-    # last-timestep duplication an unclamped gather would produce
-    ln = jnp.minimum(Length.reshape(B).astype(jnp.int32),
-                     jnp.maximum(T - off, 0))
+    # offsets and lengths clamp to the tensor bound: a compiled XLA
+    # program cannot raise on runtime values (the reference kernel
+    # host-asserts offset+length <= seqlen), and clamping beats the
+    # silent row duplication an unclamped gather would produce. Offset
+    # is clamped first so a negative offset degrades to an offset-0
+    # slice instead of an over-long one built from duplicated rows.
+    off = jnp.clip(Offset.reshape(B).astype(jnp.int32), 0, T)
+    ln = jnp.clip(Length.reshape(B).astype(jnp.int32), 0, T - off)
     t = jnp.arange(T, dtype=jnp.int32)[None, :]
     idx = jnp.clip(off[:, None] + t, 0, T - 1)          # [B, T]
     gidx = idx.reshape((B, T) + (1,) * (X.ndim - 2))

@@ -79,7 +79,7 @@ def _assign_value(ctx):
 
 @register_op("shape", propagate_seqlen=False)
 def _shape(ctx, Input):
-    return {"Out": jnp.array(Input.shape, jnp.int64)}
+    return {"Out": jnp.array(Input.shape, types.index_dtype())}
 
 
 @register_op("reshape")
@@ -317,7 +317,7 @@ def _argsort(ctx, X):
     axis = ctx.attr("axis", -1)
     idx = jnp.argsort(X, axis=axis)
     out = jnp.take_along_axis(X, idx, axis=axis)
-    return {"Out": out, "Indices": idx.astype(jnp.int64)}
+    return {"Out": out, "Indices": idx.astype(types.index_dtype())}
 
 
 @register_op("is_empty")

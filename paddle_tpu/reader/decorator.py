@@ -236,6 +236,19 @@ class PipeReader:
                 else:
                     yield buff
             else:
+                if self.file_type == "gzip":
+                    # bytes still buffered in the decompressobj at EOF
+                    # would otherwise be dropped (truncated last lines)
+                    tail = self.dec.flush()
+                    if tail:
+                        if cut_lines:
+                            remained += tail
+                        else:
+                            yield tail
                 break
-        if remained:
+        if cut_lines and remained:
+            for line in remained.split(lb):
+                if line:
+                    yield line.decode(errors="replace")
+        elif remained:
             yield remained.decode(errors="replace")
