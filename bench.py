@@ -76,16 +76,11 @@ def measure_peak_tflops(jax):
     return N_MM * 2 * 4096 ** 3 / per_call / 1e12
 
 
-def _step_flops(exe, scope, feed_arrays, jax):
+def _step_flops(exe, scope, feed_arrays):
     """XLA cost-analysis FLOPs of the largest compiled step in the cache."""
     try:
-        compiled = max(exe._cache.values(),
-                       key=lambda c: len(c.program.global_block().ops))
-        mut = {n: scope.find_var(n) for n in compiled.mut_names}
-        const = {n: scope.find_var(n) for n in compiled.const_names}
-        feeds = {k: feed_arrays[k] for k in sorted(feed_arrays)}
-        ca = (compiled._step.lower(feeds, mut, const, jax.random.key(0))
-              .compile().cost_analysis())
+        from tools._common import compile_main_step
+        ca = compile_main_step(exe, scope, feed_arrays).cost_analysis()
         return float(ca.get("flops", 0.0))
     except Exception as e:  # MFU then reads 0.0 — say why, don't hide it
         print(f"WARNING: FLOPs probe failed ({e!r}); mfu will read 0.0",
@@ -142,7 +137,7 @@ def bench_resnet(fluid, models, jax, want_flops=False):
     # config by 5x in a recorded BENCH run
     dt = sorted(window() for _ in range(3))[1]
     ips = batch_size * steps / dt
-    flops = _step_flops(exe, scope, batches[0], jax) if want_flops else 0.0
+    flops = _step_flops(exe, scope, batches[0]) if want_flops else 0.0
     return ips, flops * steps / dt
 
 
@@ -176,7 +171,7 @@ def bench_transformer(fluid, models, jax, seq_len, batch_size, fused,
 
     dt = sorted(window() for _ in range(3))[1] / steps  # median window
     tok_s = batch_size * seq_len / dt
-    flops = _step_flops(exe, scope, batch, jax) if want_flops else 0.0
+    flops = _step_flops(exe, scope, batch) if want_flops else 0.0
     return tok_s, flops / dt
 
 

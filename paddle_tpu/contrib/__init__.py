@@ -1,0 +1,7 @@
+"""`fluid.contrib` namespace (reference python/paddle/fluid/contrib/)."""
+
+from . import decoder  # noqa: F401
+from .decoder import InitState, StateCell, TrainingDecoder, BeamSearchDecoder  # noqa: F401
+
+__all__ = ["decoder", "InitState", "StateCell", "TrainingDecoder",
+           "BeamSearchDecoder"]

@@ -201,7 +201,13 @@ class _CompiledProgram:
         self.const_names = [n for n in read if n not in set(written)]
         self.new_names = [n for n in written if n not in set(read)]
 
-        def step(feeds, mut_state, const_state, key):
+        seed = program.random_seed if program.random_seed is not None else 0
+
+        def step(feeds, mut_state, const_state, counter):
+            # key derivation INSIDE the jit: an eager fold_in would
+            # dispatch 2-4 tiny device programs per run (visible in the
+            # profiler as jit__threefry_* modules), pure host overhead
+            key = jax.random.fold_in(jax.random.key(seed), counter)
             env = {}
             env.update(const_state)
             env.update(mut_state)
@@ -220,10 +226,10 @@ class _CompiledProgram:
         donate_args = (1,) if donate else ()
         self._step = jax.jit(step, donate_argnums=donate_args)
 
-    def run(self, scope: Scope, feeds: Dict[str, Any], key):
+    def run(self, scope: Scope, feeds: Dict[str, Any], counter):
         mut = {n: scope.find_var(n) for n in self.mut_names}
         const = {n: scope.find_var(n) for n in self.const_names}
-        fetches, new_state, flags = self._step(feeds, mut, const, key)
+        fetches, new_state, flags = self._step(feeds, mut, const, counter)
         for n, v in new_state.items():
             scope.set_var(n, v)
         if self.check_nan_inf and flags:
@@ -325,7 +331,8 @@ class Executor:
         cache_key = (program._uid, program._version,
                      tuple(sorted(feed_arrays)), tuple(fetch_names),
                      scope._uid, self.amp, self.check_nan_inf,
-                     _flags.get_flag("dropout_impl"))
+                     _flags.get_flag("dropout_impl"),
+                     program.random_seed)  # seed is baked into the trace
         compiled = self._cache.get(cache_key) if use_program_cache else None
         if compiled is None:
             with jax.default_device(self.place.jax_device()):
@@ -336,11 +343,10 @@ class Executor:
             if use_program_cache:
                 self._cache[cache_key] = compiled
 
-        seed = program.random_seed if program.random_seed is not None else 0
-        key = jax.random.fold_in(jax.random.key(seed), self._run_counter)
+        counter = np.uint32(self._run_counter)
         self._run_counter += 1
         with jax.default_device(self.place.jax_device()):
-            fetches = compiled.run(scope, feed_arrays, key)
+            fetches = compiled.run(scope, feed_arrays, counter)
         if return_numpy:
             fetches = [np.asarray(f) for f in fetches]
         return fetches

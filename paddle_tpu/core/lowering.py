@@ -114,7 +114,14 @@ class BlockLowerer:
             out_grads = {}
             for slot, names in fwd_outputs.items():
                 out_grads[slot] = [env.get(ir.grad_var_name(n)) for n in names]
+            # forward OUTPUT values (already materialized in env): grads
+            # that consume a saved output (reference convention, e.g.
+            # softmax_grad takes Out) read them from ctx.fwd_outs instead
+            # of recomputing
+            fwd_outs = {slot: [env.get(n) for n in names]
+                        for slot, names in fwd_outputs.items()}
             ctx = LoweringContext(fwd_attrs, key=op_key, lowerer=self, op=op)
+            ctx.fwd_outs = fwd_outs
             grads = opdef.grad_lower(ctx, ins, out_grads)
             _write_input_grads(op, fwd_inputs, grads, env)
             return

@@ -59,19 +59,27 @@ def _softmax_with_cross_entropy(ctx, Logits, Label):
 
 @register_grad("softmax_with_cross_entropy")
 def _swce_grad(ctx, ins, out_grads):
-    """Hand-written grad: dLogits = (softmax - onehot) * dLoss, recomputed
-    from the logits instead of letting jax.vjp save the f32 softmax as a
-    residual. The generic path materialized an f32 [B,T,V] probabilities
-    tensor between forward and backward — 2 GB at (64,256,30k) and the
-    allocation that OOM'd batch 256; here the f32 math lives only inside
-    one fusion and dLogits lands directly in the logits dtype (bf16 under
-    AMP — which is what the out-projection grad matmuls consume anyway)."""
+    """Hand-written grad: dLogits = (softmax - onehot) * dLoss. The
+    probabilities come from the SAVED Softmax forward output when the
+    lowerer provides it (reference softmax_with_cross_entropy_op grad
+    consumes Softmax the same way) — the backward is then pure
+    elementwise and fuses into the grad matmul's operand, instead of
+    re-running the max/sum reductions over the [B*T, V] logits (round-4
+    profile: the recompute cost ~3 ms/step as standalone reduce fusions).
+    Falls back to recomputation when the saved output is unavailable.
+    Never asks jax.vjp to save an f32 probabilities residual — 2 GB at
+    (64,256,30k), the allocation that OOM'd batch 256 in round 3."""
     Logits, Label = ins["Logits"][0], ins["Label"][0]
     gL = out_grads.get("Loss", [None])[0]
     gS = out_grads.get("Softmax", [None])[0]
-    logits32 = Logits.astype(jnp.float32)
-    lse = jax.scipy.special.logsumexp(logits32, axis=-1, keepdims=True)
-    softmax = jnp.exp(logits32 - lse)           # fused into the consumers
+    saved = getattr(ctx, "fwd_outs", {}).get("Softmax", [None])[0]
+    if saved is not None:
+        softmax = saved.astype(jnp.float32)
+        logits32 = lse = None
+    else:
+        logits32 = Logits.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits32, axis=-1, keepdims=True)
+        softmax = jnp.exp(logits32 - lse)       # fused into the consumers
     d = jnp.zeros_like(softmax)
     soft_label = ctx.attr("soft_label", False)
     d_label = None
@@ -79,6 +87,11 @@ def _swce_grad(ctx, ins, out_grads):
         # always materialize the Label cotangent: backward.py may have
         # declared Label@GRAD even when only the Softmax output is used
         d_label = jnp.zeros(Label.shape, Label.dtype)
+    if soft_label and logits32 is None:
+        # the Label cotangent needs log_softmax — recompute from logits
+        # (soft-label is off the hot transformer path)
+        logits32 = Logits.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits32, axis=-1, keepdims=True)
     if gL is not None:
         gL32 = gL.astype(jnp.float32)
         if soft_label:

@@ -152,3 +152,46 @@ def _proximal_adagrad(ctx, Param, Grad, Moment, LearningRate):
     prox = Param - lr * Grad
     p = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / (1.0 + lr * l2)
     return {"ParamOut": p, "MomentOut": m}
+
+
+@register_op("average_accumulates", propagate_seqlen=False)
+def _average_accumulates(ctx, param, in_sum_1, in_sum_2, in_sum_3,
+                         in_num_accumulates, in_old_num_accumulates,
+                         in_num_updates):
+    """Sliding-window parameter-sum maintenance for ModelAverage
+    (reference average_accumulates_op.h:44-135). Three-tier summation
+    avoids precision loss: sum_1 rolls into sum_2 every 16384 updates;
+    when the window exceeds min(max_average_window, num_updates *
+    average_window) everything rolls into sum_3 and the counters reset.
+    Branches become selects — the counters are scalars, so this costs
+    nothing next to the parameter-sized adds."""
+    avg_win = float(ctx.attr("average_window", 0.0))
+    max_win = int(ctx.attr("max_average_window", 10000))
+    min_win = int(ctx.attr("min_average_window", 10000))
+    k_max = 16384  # kMaxNumAccumulates
+
+    cdtype = in_num_updates.dtype
+    num_updates = in_num_updates + 1
+    num_acc = in_num_accumulates + 1
+    nu = num_updates.reshape(())
+    na = num_acc.reshape(())
+
+    s1 = in_sum_1 + param
+    roll = (nu % k_max) == 0
+    s2 = jnp.where(roll, in_sum_2 + s1, in_sum_2)
+    s1 = jnp.where(roll, jnp.zeros_like(s1), s1)
+
+    # window threshold: min(max_win, int(num_updates * average_window)),
+    # matching the reference's std::min<int64_t> truncation
+    win = jnp.minimum(jnp.asarray(max_win, cdtype),
+                      (nu.astype(jnp.float32) * avg_win).astype(cdtype))
+    trigger = (na >= min_win) & (na >= win)
+    s3 = jnp.where(trigger, s1 + s2, in_sum_3)
+    s1 = jnp.where(trigger, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(trigger, jnp.zeros_like(s2), s2)
+    old = jnp.where(trigger, num_acc, in_old_num_accumulates)
+    num_acc = jnp.where(trigger, jnp.zeros_like(num_acc), num_acc)
+    return {"out_sum_1": s1, "out_sum_2": s2, "out_sum_3": s3,
+            "out_num_accumulates": num_acc,
+            "out_old_num_accumulates": old,
+            "out_num_updates": num_updates}

@@ -82,7 +82,45 @@ def _shape(ctx, Input):
     return {"Out": jnp.array(Input.shape, types.index_dtype())}
 
 
-@register_op("reshape")
+def _reshape_infer(ctx, structs):
+    """Exact static-shape rule. eval_shape can't be used here: the dynamic
+    batch dim is substituted with the (prime) DIM_SENTINEL, and a target
+    like [-1, K] would need SENTINEL % K == 0. With a dynamic input dim,
+    the -1 output dim is simply dynamic — runtime shapes are
+    authoritative."""
+    import math as _m
+    from ..core.registry import DIM_SENTINEL
+
+    X = structs["X"][0]
+    target = [int(s) for s in ctx.attr("shape")]
+    target = [int(X.shape[i]) if s == 0 else s
+              for i, s in enumerate(target)]
+    dynamic_in = any(d >= DIM_SENTINEL and d % DIM_SENTINEL == 0
+                     for d in X.shape)
+    if -1 in target:
+        known = _m.prod(d for d in target if d != -1)
+        neg = target.index(-1)
+        total = _m.prod(int(d) for d in X.shape)
+        if known and total % known == 0:
+            # exact: stays a sentinel multiple when the -1 absorbs the
+            # dynamic batch, yields the true static dim when it doesn't
+            # (e.g. reshape([0, -1]) of a [-1, 4, 8] input -> (-1, 32))
+            target[neg] = total // known
+        elif dynamic_in:
+            target[neg] = DIM_SENTINEL
+        else:
+            raise ValueError(
+                f"reshape: cannot infer -1 dim reshaping {tuple(X.shape)} "
+                f"to {ctx.attr('shape')}")
+    elif dynamic_in:
+        # all-target-dims-concrete reshape of a dynamic tensor: the dim
+        # that absorbs the batch is unknowable statically; leave the
+        # declared target (runtime authoritative)
+        pass
+    return {"Out": jax.ShapeDtypeStruct(tuple(target), X.dtype)}
+
+
+@register_op("reshape", infer=_reshape_infer)
 def _reshape(ctx, X, Shape=None):
     shape = [int(s) for s in ctx.attr("shape")]
     # reference reshape_op.cc: 0 means "copy this dim from input".

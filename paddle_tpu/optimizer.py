@@ -12,6 +12,7 @@ donated, so the whole training iteration is one fused device program.
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 from .core import ir
@@ -359,15 +360,120 @@ class FtrlOptimizer(Optimizer):
 
 
 class ModelAverage(Optimizer):
-    """Reference optimizer.py ModelAverage — maintains a running average of
-    parameters for eval. TPU variant keeps sum accumulators updated in-graph;
-    `apply()`/`restore()` swap averaged params in the scope."""
+    """Sliding-window parameter averaging for eval (reference
+    optimizer.py:1111 + average_accumulates_op.h).
 
-    def __init__(self, average_window_rate=0.15, min_average_window=10000,
-                 max_average_window=10000, **kw):
+    Construct AFTER ``optimizer.minimize(loss)`` on the training program:
+    it appends one ``average_accumulates`` op per parameter to the main
+    program (the sums update in the same fused XLA step as the training
+    update), and builds standalone apply/restore programs that swap the
+    averaged values into the parameters around an eval pass::
+
+        with model_average.apply(exe, scope=scope):
+            ... run eval programs: params hold the window average ...
+        # params restored afterwards
+    """
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, main_program=None, **kw):
         super().__init__(0.0, **kw)
-        raise NotImplementedError(
-            "ModelAverage arrives with the high-level Trainer parity milestone")
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        program = main_program or ir.default_main_program()
+        self._backups: Dict[str, str] = {}
+
+        params = [p for p in program.global_block().all_parameters()
+                  if getattr(p, "do_model_average", None) is not False]
+        block = program.global_block()
+        self._create_accumulators(block, params)
+        for p in params:
+            self._append_accumulate_op(block, p)
+
+        self.apply_program = self._build_apply_program(params)
+        self.restore_program = self._build_restore_program(params)
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("sum_1", p)
+            self._add_accumulator("sum_2", p)
+            self._add_accumulator("sum_3", p)
+            for ctr in ("num_accumulates", "old_num_accumulates",
+                        "num_updates"):
+                self._add_accumulator(ctr, p, dtype="int32", shape=(1,))
+
+    def _append_accumulate_op(self, block, p):
+        accs = {n: self._get_accumulator(n, p)
+                for n in ("sum_1", "sum_2", "sum_3", "num_accumulates",
+                          "old_num_accumulates", "num_updates")}
+        block.append_op(
+            "average_accumulates",
+            inputs={"param": [p.name],
+                    **{f"in_{n}": [v.name] for n, v in accs.items()}},
+            outputs={f"out_{n}": [v.name] for n, v in accs.items()},
+            attrs={"average_window": self.average_window,
+                   "min_average_window": self.min_average_window,
+                   "max_average_window": self.max_average_window,
+                   "__role__": "optimize"})
+
+    def _clone_into(self, block, var):
+        return block.create_var(name=var.name, shape=var.shape,
+                                dtype=var.dtype, persistable=True,
+                                stop_gradient=True)
+
+    def _build_apply_program(self, params):
+        from . import layers
+        prog = ir.Program()
+        with ir.program_guard(prog), unique_name.guard():
+            block = prog.global_block()
+            for p in params:
+                param = self._clone_into(block, p)
+                accs = [self._clone_into(block, self._get_accumulator(n, p))
+                        for n in ("sum_1", "sum_2", "sum_3")]
+                ctrs = [self._clone_into(block, self._get_accumulator(n, p))
+                        for n in ("num_accumulates", "old_num_accumulates")]
+                backup = block.create_var(
+                    name=unique_name.generate(p.name + ".model_average_bak"),
+                    shape=p.shape, dtype=p.dtype, persistable=True,
+                    stop_gradient=True)
+                self._backups[p.name] = backup.name
+                layers.assign(input=param, output=backup)
+                total = layers.cast(layers.sums(ctrs), dtype=param.dtype)
+                avg = layers.elementwise_div(x=layers.sums(accs), y=total)
+                layers.assign(input=avg, output=param)
+        return prog
+
+    def _build_restore_program(self, params):
+        from . import layers
+        prog = ir.Program()
+        with ir.program_guard(prog), unique_name.guard():
+            block = prog.global_block()
+            for p in params:
+                param = self._clone_into(block, p)
+                backup = block.create_var(name=self._backups[p.name],
+                                          shape=p.shape, dtype=p.dtype,
+                                          persistable=True,
+                                          stop_gradient=True)
+                layers.assign(input=backup, output=param)
+        return prog
+
+    @contextmanager
+    def apply(self, executor, need_restore=True, scope=None):
+        """Swap window-averaged values into the parameters
+        (reference optimizer.py:1247)."""
+        kw = {"scope": scope} if scope is not None else {}
+        executor.run(self.apply_program, **kw)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor, scope=scope)
+
+    def restore(self, executor, scope=None):
+        """Restore the pre-apply parameter values (reference
+        optimizer.py:1268)."""
+        kw = {"scope": scope} if scope is not None else {}
+        executor.run(self.restore_program, **kw)
 
 
 SGD = SGDOptimizer

@@ -177,10 +177,9 @@ class ParallelExecutor:
                                         mesh=self._mesh)
             self._cache[key] = compiled
 
-        seed = self._program.random_seed if self._program.random_seed is not None else 0
-        prng = jax.random.fold_in(jax.random.key(seed), self._run_counter)
+        counter = np.uint32(self._run_counter)
         self._run_counter += 1
-        fetches = compiled.run(self._scope, feed_arrays, prng)
+        fetches = compiled.run(self._scope, feed_arrays, counter)
         if return_numpy:
             fetches = [self._fetch_numpy(f) for f in fetches]
         return fetches
@@ -243,7 +242,7 @@ class ParallelExecutor:
         mut = {n: self._scope.find_var(n) for n in compiled.mut_names}
         const = {n: self._scope.find_var(n) for n in compiled.const_names}
         return compiled._step.lower({k: feeds[k] for k in sorted(feeds)},
-                                    mut, const, jax.random.key(0)).as_text()
+                                    mut, const, np.uint32(0)).as_text()
 
     def _shard_feed(self, arr, var=None):
         # already-global arrays (dist.shard_local_batch on multi-host, or a

@@ -247,8 +247,10 @@ class PipeReader:
                             yield tail
                 break
         if cut_lines and remained:
-            for line in remained.split(lb):
-                if line:
-                    yield line.decode(errors="replace")
+            lines = remained.split(lb)
+            if lines and lines[-1] == b"":   # trailing line break only
+                lines.pop()
+            for line in lines:
+                yield line.decode(errors="replace")
         elif remained:
             yield remained.decode(errors="replace")

@@ -19,3 +19,27 @@ def test_every_reference_export_present():
     from tools.api_parity import missing_symbols
     gaps = missing_symbols()
     assert not gaps, f"reference exports missing from paddle_tpu: {gaps}"
+
+
+def test_no_export_raises_on_use():
+    """A present-but-raising export must never count as parity (round-3
+    verdict: a stub ModelAverage shipped inside a 100% claim). The
+    detector flags any export whose body or __init__ starts with an
+    unconditional raise."""
+    from tools.api_parity import stub_symbols, _body_is_stub
+
+    # self-check: the detector catches the exact round-3 failure shape
+    class Stub:
+        def __init__(self):
+            raise NotImplementedError("later")
+
+    class Guarded:
+        def __init__(self, mode="a"):
+            if mode not in ("a", "b"):
+                raise ValueError(mode)
+            self.mode = mode
+
+    assert _body_is_stub(Stub.__init__)
+    assert not _body_is_stub(Guarded.__init__)
+
+    assert stub_symbols() == []

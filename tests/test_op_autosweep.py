@@ -411,6 +411,19 @@ SPECS.update({
         inputs={"Param": T(3, 2), "Grad": T(3, 2), "Moment": POS(3, 2),
                 "LearningRate": np.array([0.1], np.float32)},
         outs=("ParamOut",), grad=[]),
+    # step below min_average_window: sum_1 accumulates param, counters tick
+    "average_accumulates": Spec(
+        inputs={"param": T(3, 2), "in_sum_1": T(3, 2),
+                "in_sum_2": np.zeros((3, 2), np.float32),
+                "in_sum_3": np.zeros((3, 2), np.float32),
+                "in_num_accumulates": np.array([1], np.int32),
+                "in_old_num_accumulates": np.array([0], np.int32),
+                "in_num_updates": np.array([1], np.int32)},
+        attrs={"average_window": 0.15, "min_average_window": 100,
+               "max_average_window": 1000},
+        outs=("out_sum_1", "out_num_accumulates", "out_num_updates"),
+        grad=[],
+        check=lambda o: (o[1][0] == 2 and o[2][0] == 2)),
 
     # ---- RNG ops: forward-only statistical checks -------------------------
     "dropout": Spec(inputs={"X": np.ones((50, 50), np.float32)},

@@ -119,3 +119,56 @@ def test_distribute_transpiler_annotates_embeddings():
     with pytest.raises(ValueError):
         # async mode is the host pserver runtime and needs endpoints
         fluid.DistributeTranspiler().transpile(0, sync_mode=False)
+
+
+def test_model_average_matches_window_simulation():
+    """ModelAverage numeric parity with the reference accumulate rules
+    (reference optimizer.py:1111 + average_accumulates_op.h): the applied
+    value equals the brute-force average over the window, and restore()
+    brings the live parameters back."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        pred = layers.fc(input=x, size=1)
+        loss = layers.mean(layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        ma = fluid.optimizer.ModelAverage(
+            average_window_rate=0.5, min_average_window=2,
+            max_average_window=3)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+
+    pname = main.global_block().all_parameters()[0].name
+    rng = np.random.RandomState(7)
+    post_step = []
+    for _ in range(7):
+        exe.run(main, feed={"x": rng.rand(8, 4).astype(np.float32),
+                            "y": rng.rand(8, 1).astype(np.float32)},
+                fetch_list=[loss], scope=scope)
+        post_step.append(np.array(scope.find_var(pname)))
+
+    # brute-force simulation of average_accumulates_op.h
+    s1 = s2 = s3 = 0.0
+    na = old = nu = 0
+    for p in post_step:
+        nu += 1
+        na += 1
+        s1 = s1 + p
+        win = min(3, int(nu * 0.5))
+        if na >= 2 and na >= win:
+            s3 = s1 + s2
+            s1, s2 = 0.0, 0.0
+            old, na = na, 0
+    expected = (s1 + s2 + s3) / (na + old)
+
+    live = np.array(scope.find_var(pname))
+    with ma.apply(exe, scope=scope):
+        applied = np.array(scope.find_var(pname))
+    restored = np.array(scope.find_var(pname))
+
+    np.testing.assert_allclose(applied, expected, rtol=1e-5)
+    np.testing.assert_allclose(restored, live, rtol=0)
+    assert not np.allclose(applied, live)

@@ -10,12 +10,12 @@ from __future__ import annotations
 
 def compile_main_step(exe, scope, feed):
     """exe must have run the program at least once with `feed`."""
-    import jax
+    import numpy as np
 
     compiled = max(exe._cache.values(),
                    key=lambda c: len(c.program.global_block().ops))
     mut = {n: scope.find_var(n) for n in compiled.mut_names}
     const = {n: scope.find_var(n) for n in compiled.const_names}
     feeds = {k: feed[k] for k in sorted(feed)}
-    return (compiled._step.lower(feeds, mut, const, jax.random.key(0))
+    return (compiled._step.lower(feeds, mut, const, np.uint32(0))
             .compile())

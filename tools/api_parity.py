@@ -84,16 +84,8 @@ def ref_all(path: str):
 
 
 def _resolve(root, attr_path, name):
-    obj = root
-    if attr_path:
-        for part in attr_path.split("."):
-            obj = getattr(obj, part, None)
-            if obj is None:
-                return False
-    if hasattr(obj, name):
-        return True
-    # layers/* symbols are also commonly reached from the package root
-    return attr_path is None and hasattr(root.layers, name)
+    # exports bound to None are treated as missing — the audit's intent
+    return _get(root, attr_path, name) is not None
 
 
 def missing_symbols():
@@ -110,6 +102,71 @@ def missing_symbols():
             if not found:
                 gaps.append((path, name))
     return gaps
+
+
+def _get(root, attr_path, name):
+    obj = root
+    if attr_path:
+        for part in attr_path.split("."):
+            obj = getattr(obj, part, None)
+            if obj is None:
+                return None
+    got = getattr(obj, name, None)
+    if got is None and attr_path is not None:
+        got = getattr(root, name, None)
+    if got is None and attr_path is None:
+        got = getattr(root.layers, name, None)
+    return got
+
+
+def _body_is_stub(fn):
+    """True iff the callable's first effective statement is an
+    unconditional `raise` — i.e. the symbol exists but cannot work.
+    Conditional guards (unsupported-argument checks) don't count."""
+    import ast
+    import inspect
+    import textwrap
+
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return False
+    node = tree.body[0]
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    body = [s for s in node.body
+            if not (isinstance(s, ast.Expr)
+                    and isinstance(s.value, ast.Constant))]
+    while body and isinstance(body[0], ast.Expr):
+        body = body[1:]
+    return bool(body) and isinstance(body[0], ast.Raise)
+
+
+def stub_symbols():
+    """Exports that resolve but raise on use — the hasattr-level audit
+    alone let a raising ModelAverage ship inside a '100% parity' claim
+    (round-3 verdict); this pass makes that impossible."""
+    import inspect
+
+    import paddle_tpu
+
+    stubs = []
+    for path, attr in MODULES:
+        for name in ref_all(path):
+            if (path, name) in WAIVED:
+                continue
+            obj = _get(paddle_tpu, attr, name)
+            if obj is None:
+                continue  # reported by missing_symbols
+            if inspect.isclass(obj):
+                for meth_name in ("__init__", "__call__"):
+                    meth = obj.__dict__.get(meth_name)
+                    if meth is not None and _body_is_stub(meth):
+                        stubs.append((path, f"{name}.{meth_name}"))
+            elif callable(obj) and _body_is_stub(obj):
+                stubs.append((path, name))
+    return stubs
 
 
 def main():
@@ -136,6 +193,11 @@ def main():
     print(f"\ncoverage: {ok}/{total} "
           f"({100.0 * ok / total:.1f}%) reference exports present; "
           f"{waived_count} waived (retired subsystems, see docs/RETIREMENT.md)")
+    stubs = stub_symbols()
+    if stubs:
+        print(f"STUBS (present but raise on use): {stubs}")
+    else:
+        print("stub check: no export raises on use")
 
 
 if __name__ == "__main__":
