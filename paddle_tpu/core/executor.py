@@ -343,8 +343,17 @@ class Executor:
             if use_program_cache:
                 self._cache[cache_key] = compiled
 
-        counter = np.uint32(self._run_counter)
-        self._run_counter += 1
+        if program.random_seed is not None:
+            # a SEEDED program is fully deterministic: every run derives
+            # the same keys, independent of what this executor ran before
+            # (reference semantics — random_seed pins per-op seed attrs at
+            # build time, so a seeded startup re-initializes identically
+            # and seeded dropout repeats its mask). Unseeded programs get
+            # fresh randomness per run via the counter.
+            counter = np.uint32(0)
+        else:
+            counter = np.uint32(self._run_counter)
+            self._run_counter += 1
         with jax.default_device(self.place.jax_device()):
             fetches = compiled.run(scope, feed_arrays, counter)
         if return_numpy:

@@ -73,7 +73,12 @@ def _swce_grad(ctx, ins, out_grads):
     gL = out_grads.get("Loss", [None])[0]
     gS = out_grads.get("Softmax", [None])[0]
     saved = getattr(ctx, "fwd_outs", {}).get("Softmax", [None])[0]
-    if saved is not None:
+    if saved is not None and saved.dtype != jnp.float32:
+        # use the saved (bf16/f16 under AMP) probabilities — reference
+        # grad convention. NOT when f32: a live f32 [B*T, V] residual
+        # across the fwd/bwd boundary is the 2 GB allocation that OOM'd
+        # batch 256 in round 3; recompute instead (XLA CSEs it with the
+        # forward when profitable, so this costs nothing when it fuses)
         softmax = saved.astype(jnp.float32)
         logits32 = lse = None
     else:

@@ -35,15 +35,26 @@ from ..core.registry import register_op
 NEG_INF = -1e30
 
 
+# sweep override: (BQ, BK) or None -> tuned default (tools/flash_probe.py)
+_BLOCK_OVERRIDE = None
+
+
 def _blk(T):
-    """Block size: biggest power-of-two tile dividing T (tuned on v5e:
-    512 beats 256 by ~8% in an interleaved fwd+bwd A/B at seq 2048).
-    Since the kernels stream K/V (resp. Q) through the grid's innermost
-    dimension, VMEM per program is O(blk^2 + blk*D) regardless of T — no
-    sequence-length cap is needed (validated to seq 32768)."""
-    for b in (512, 256, 128):
+    """Block sizes (BQ, BK): biggest power-of-two tile <= 1024 dividing
+    T. Tuned by the round-4 chained sweep on v5e (tools/flash_block_sweep
+    .py, docs/PERF.md): 1024x1024 is the reproducible winner at seq
+    2048-4096, causal and not (-18%..-29% vs the round-3 512x512; bigger
+    streamed BK means fewer sequential grid steps to pipeline). Since the
+    kernels stream K/V (resp. Q) through the grid's innermost dimension,
+    VMEM per program is O(blk_q * blk_k + blk * D) regardless of T — no
+    sequence-length cap (validated to seq 32768)."""
+    if _BLOCK_OVERRIDE is not None:
+        bq, bk = _BLOCK_OVERRIDE
+        if T % bq == 0 and T % bk == 0:
+            return bq, bk
+    for b in (1024, 512, 256, 128):
         if T % b == 0:
-            return b
+            return b, b
     raise ValueError(f"flash attention needs T % 128 == 0, got {T}")
 
 
@@ -140,11 +151,16 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(live)
     def _update():
-        q = q_ref[0].astype(jnp.float32) * sm_scale    # [blk_q, D]
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # dots run in the INPUT dtype (bf16 under AMP -> full MXU rate;
+        # the round-3 kernels upcast to f32 first, quartering matmul
+        # throughput) with f32 accumulation via preferred_element_type;
+        # sm_scale is applied to the f32 product so no operand precision
+        # is spent on it
+        q = q_ref[0]                                   # [blk_q, D]
+        k = k_ref[0]
+        v = v_ref[0]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+                            preferred_element_type=jnp.float32) * sm_scale
         if causal:
             s = _apply_causal_mask(s, qi, kj, blk_q, blk_k)
         m = m_sc[...]
@@ -158,7 +174,8 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                                  dropout_rate)
             p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
         acc_sc[...] = acc_sc[...] * alpha[:, None] + lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_sc[...] = m_new
         l_sc[...] = l_new
 
@@ -191,14 +208,14 @@ def _flash_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(live)
     def _update():
-        q = q_ref[0].astype(jnp.float32) * sm_scale
-        do = do_ref[0].astype(jnp.float32)             # [blk_q, D]
+        q = q_ref[0]
+        do = do_ref[0]                                 # [blk_q, D]
         lse = lse_ref[0, 0]                            # [blk_q]
         delta = delta_ref[0, 0]
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        k = k_ref[0]
+        v = v_ref[0]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+                            preferred_element_type=jnp.float32) * sm_scale
         if causal:
             s = _apply_causal_mask(s, qi, kj, blk_q, blk_k)
         w = jnp.exp(s - lse[:, None])                  # normalized weights
@@ -210,15 +227,14 @@ def _flash_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             dw = jnp.where(keep, dpv / (1.0 - dropout_rate), 0.0)
         else:
             dw = dpv
-        ds = w * (dw - delta[:, None])
+        ds = w * (dw - delta[:, None]) * sm_scale
         dq_sc[...] = dq_sc[...] + lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(kj == nk - 1)
     def _finalize():
-        # s = sm_scale * (q . k)  =>  dq = sm_scale * ds @ k
-        dq_ref[0] = (dq_sc[...] * sm_scale).astype(dq_ref.dtype)
+        dq_ref[0] = dq_sc[...].astype(dq_ref.dtype)
 
 
 def _flash_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -245,14 +261,14 @@ def _flash_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(live)
     def _update():
-        k = k_ref[0].astype(jnp.float32)               # [blk_k, D]
-        v = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32) * sm_scale
-        do = do_ref[0].astype(jnp.float32)
+        k = k_ref[0]                                   # [blk_k, D]
+        v = v_ref[0]
+        q = q_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+                            preferred_element_type=jnp.float32) * sm_scale
         if causal:
             s = _apply_causal_mask(s, qi, kj, blk_q, blk_k)
         w = jnp.exp(s - lse[:, None])                  # [blk_q, blk_k]
@@ -266,16 +282,16 @@ def _flash_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         else:
             w_drop, dw = w, dpv
         dv_sc[...] = dv_sc[...] + lax.dot_general(
-            w_drop, do, (((0,), (0,)), ((), ())),
+            w_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = w * (dw - delta[:, None])
+        ds = w * (dw - delta[:, None]) * sm_scale
         dk_sc[...] = dk_sc[...] + lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == nq - 1)
     def _finalize():
-        dk_ref[0] = dk_sc[...].astype(dk_ref.dtype)  # q pre-scaled: has sm_scale
+        dk_ref[0] = dk_sc[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
 
 
@@ -300,7 +316,7 @@ def _flash_forward(q, k, v, causal, sm_scale, dropout_rate=0.0, seed=0):
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, T, D = q.shape
-    BQ = BK = _blk(T)
+    BQ, BK = _blk(T)
     q3 = q.reshape(B * H, T, D)
     k3 = k.reshape(B * H, T, D)
     v3 = v.reshape(B * H, T, D)
@@ -347,7 +363,7 @@ def _flash_backward(q, k, v, o, lse, g, causal, sm_scale, dropout_rate, seed):
 
     from jax.experimental.pallas import tpu as pltpu
 
-    BQ = BK = _blk(T)
+    BQ, BK = _blk(T)
     dq_kernel = functools.partial(_flash_dq_kernel, sm_scale=sm_scale,
                                   causal=causal, dropout_rate=dropout_rate)
     dq = pl.pallas_call(

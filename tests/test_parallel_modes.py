@@ -1,0 +1,148 @@
+"""Numeric parity for the previously-untested parallel modes (round-4
+verdict item 2): tensor parallelism ('mp') and the ZeRO-style
+`BuildStrategy.ReduceStrategy.Reduce` sharded-state mode.
+
+Reference discipline: parallel_executor_test_base.py:27
+`check_network_convergence` — train N steps on one device and on the
+parallel executor from identical seeded init and identical data, compare
+the loss trajectories. Reduce-mode additionally asserts the optimizer
+state is REALLY sharded (details/build_strategy.h:23-37 analog).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu import models
+from paddle_tpu.parallel import mesh as mesh_lib
+from paddle_tpu.parallel.parallel_executor import BuildStrategy
+
+STEPS = 3
+
+
+def _build(optimizer=None, dropout=0.0, fused=True):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        feeds, fetches = models.transformer.build(
+            src_vocab_size=64, trg_vocab_size=64, seq_len=32,
+            n_layer=2, n_head=2, d_model=32, d_inner=64,
+            dropout_rate=dropout, fused_attention=fused)
+        loss = fetches["loss"]
+        (optimizer or fluid.optimizer.SGD(learning_rate=0.1)).minimize(loss)
+    main.random_seed = startup.random_seed = 7
+    return main, startup, loss
+
+
+def _batches(n=STEPS):
+    rng = np.random.RandomState(3)
+    out = []
+    for _ in range(n):
+        src = rng.randint(1, 64, (8, 32)).astype(np.int32)
+        out.append({"src_word": src, "trg_word": src, "lbl_word": src})
+    return out
+
+
+def _single_device_losses(main, startup, loss, batches):
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    return [float(np.asarray(exe.run(main, feed=b, fetch_list=[loss],
+                                     scope=scope)[0]))
+            for b in batches]
+
+
+def _pe_losses(main, startup, loss, batches, mesh, build_strategy=None):
+    scope = fluid.Scope()
+    fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
+    pe = fluid.ParallelExecutor(loss_name=loss.name, main_program=main,
+                                scope=scope, mesh=mesh,
+                                build_strategy=build_strategy)
+    return pe, scope, [float(np.asarray(pe.run(feed=b,
+                                               fetch_list=[loss.name])[0]))
+                       for b in batches]
+
+
+def _needs8():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+
+
+def test_mp_parity_dp2_mp4():
+    """dp=2 x mp=4: Megatron-style sharded q/k/v/ffn weights must produce
+    the single-device loss trajectory exactly (GSPMD inserts the
+    all-reduces the reference would hand-wire)."""
+    _needs8()
+    main, startup, loss = _build()
+    batches = _batches()
+    ref = _single_device_losses(main, startup, loss, batches)
+    m = mesh_lib.make_mesh([2, 4], ["dp", "mp"])
+    pe, scope, got = _pe_losses(main, startup, loss, batches, m)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+    # an mp-annotated weight is genuinely sharded over 'mp'
+    mp_shards = [n for n in scope.local_var_names()
+                 if hasattr(scope.find_var(n), "sharding")
+                 and "mp" in str(getattr(scope.find_var(n), "sharding", ""))]
+    assert mp_shards, "no scope var is mp-sharded"
+
+
+def test_mp_sp_parity_dp2_mp2_sp2():
+    """The full hybrid mesh: dp x mp x sp with ring attention."""
+    _needs8()
+    main, startup, loss = _build()
+    batches = _batches()
+    ref = _single_device_losses(main, startup, loss, batches)
+    m = mesh_lib.make_mesh([2, 2, 2], ["dp", "mp", "sp"])
+    _, _, got = _pe_losses(main, startup, loss, batches, m)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_reduce_strategy_parity_and_sharded_state():
+    """ReduceStrategy.Reduce (ZeRO analog, reference
+    details/reduce_op_handle.cc): same numerics as AllReduce/single
+    device, optimizer accumulators physically sharded over 'dp'."""
+    _needs8()
+    opt = fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9)
+    main, startup, loss = _build(optimizer=opt)
+    batches = _batches()
+    ref = _single_device_losses(main, startup, loss, batches)
+
+    bs = BuildStrategy()
+    bs.reduce_strategy = BuildStrategy.ReduceStrategy.Reduce
+    m = mesh_lib.make_mesh([8], ["dp"])
+    pe, scope, got = _pe_losses(main, startup, loss, batches, m,
+                                build_strategy=bs)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+    # optimizer state (velocity accumulators) is sharded over dp, not
+    # replicated — the point of Reduce mode
+    sharded = []
+    for n in scope.local_var_names():
+        if "velocity" not in n:
+            continue
+        v = scope.find_var(n)
+        spec = getattr(getattr(v, "sharding", None), "spec", None)
+        if spec and tuple(spec)[:1] == ("dp",):
+            sharded.append(n)
+    assert sharded, "no velocity accumulator carries a ('dp', ...) sharding"
+
+
+def test_reduce_strategy_matches_allreduce_mode():
+    """Both ReduceStrategy modes agree with each other step for step
+    (reference tests exercise both, test_parallel_executor_*)."""
+    _needs8()
+    opt = fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9)
+    main, startup, loss = _build(optimizer=opt)
+    batches = _batches()
+    m = mesh_lib.make_mesh([8], ["dp"])
+    _, _, ar = _pe_losses(main, startup, loss, batches, m)
+
+    opt2 = fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9)
+    main2, startup2, loss2 = _build(optimizer=opt2)
+    bs = BuildStrategy()
+    bs.reduce_strategy = BuildStrategy.ReduceStrategy.Reduce
+    _, _, rd = _pe_losses(main2, startup2, loss2, batches, m,
+                          build_strategy=bs)
+    np.testing.assert_allclose(rd, ar, rtol=2e-4, atol=2e-5)

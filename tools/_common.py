@@ -19,3 +19,26 @@ def compile_main_step(exe, scope, feed):
     feeds = {k: feed[k] for k in sorted(feed)}
     return (compiled._step.lower(feeds, mut, const, np.uint32(0))
             .compile())
+
+
+def parse_flag(argv, name, default):
+    """`--name value` or `--name=value`."""
+    for i, a in enumerate(argv):
+        if a == name and i + 1 < len(argv):
+            return argv[i + 1]
+        if a.startswith(name + "="):
+            return a.split("=", 1)[1]
+    return default
+
+
+def slope_step_time(window, steps, lo=None, rounds=3):
+    """Two-point-slope per-step time, median of `rounds`: a window pays
+    one ~90 ms tunnel sync regardless of length, so dividing a single
+    window by its step count inflates per-step time (~8 ms at 12 steps);
+    the slope is what a steady-state training loop sees."""
+    lo = lo or max(2, steps // 4)
+    slopes = []
+    for _ in range(rounds):
+        t_lo, t_hi = window(lo), window(steps)
+        slopes.append((t_hi - t_lo) / (steps - lo))
+    return sorted(slopes)[len(slopes) // 2]
