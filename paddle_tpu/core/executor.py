@@ -261,7 +261,7 @@ class Executor:
         # (a new cache entry compiles with the checks baked in).
         self._check_nan_inf = check_nan_inf
         self._cache: Dict[tuple, _CompiledProgram] = {}
-        self._run_counter = 0
+        self._run_counts: Dict[int, int] = {}  # program uid -> runs so far
 
     @property
     def check_nan_inf(self) -> bool:
@@ -343,17 +343,15 @@ class Executor:
             if use_program_cache:
                 self._cache[cache_key] = compiled
 
-        if program.random_seed is not None:
-            # a SEEDED program is fully deterministic: every run derives
-            # the same keys, independent of what this executor ran before
-            # (reference semantics — random_seed pins per-op seed attrs at
-            # build time, so a seeded startup re-initializes identically
-            # and seeded dropout repeats its mask). Unseeded programs get
-            # fresh randomness per run via the counter.
-            counter = np.uint32(0)
-        else:
-            counter = np.uint32(self._run_counter)
-            self._run_counter += 1
+        # PER-PROGRAM run counter: the PRNG key is fold_in(key(seed),
+        # runs-of-THIS-program), so a seeded startup re-initializes
+        # identically no matter what else this executor ran (cross-
+        # executor/mesh parity), while seeded TRAINING still draws a
+        # fresh-but-reproducible mask every step (reference random_seed
+        # reproducibility with per-step variation — the round-3 dropout
+        # contract, tests/test_amp_perf_ops.py)
+        counter = np.uint32(self._run_counts.get(program._uid, 0))
+        self._run_counts[program._uid] = int(counter) + 1
         with jax.default_device(self.place.jax_device()):
             fetches = compiled.run(scope, feed_arrays, counter)
         if return_numpy:

@@ -353,10 +353,17 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
     helper = LayerHelper("softmax_with_cross_entropy")
     softmax_out = helper.create_variable_for_type_inference(dtype=logits.dtype)
     loss = helper.create_variable_for_type_inference(dtype=logits.dtype)
+    # hidden log-sum-exp output ([rows, 1] f32 — tiny): the grad rule
+    # rebuilds softmax as exp(logits - lse) from it, pure elementwise, so
+    # the backward re-runs no [rows, V] reductions and no [rows, V]
+    # probabilities tensor crosses the fwd/bwd boundary
+    lse_out = helper.create_variable_for_type_inference(dtype="float32")
     helper.append_op("softmax_with_cross_entropy",
                      inputs={"Logits": [logits.name], "Label": [label.name]},
-                     outputs={"Softmax": [softmax_out.name], "Loss": [loss.name]},
+                     outputs={"Softmax": [softmax_out.name], "Loss": [loss.name],
+                              "LSE": [lse_out.name]},
                      attrs={"soft_label": soft_label, "ignore_index": ignore_index})
+    lse_out.stop_gradient = True
     if return_softmax:
         return loss, softmax_out
     return loss
@@ -627,17 +634,26 @@ def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
 
 # -- sequence layers (LoD analogs) ------------------------------------------
 
+# layers whose op rules implement the innermost-level (nested LoD)
+# adapter — everything else still refuses level-2 input at build time
+# rather than failing cryptically inside jit tracing
+_NESTED_CAPABLE = {"sequence_pool", "sequence_softmax", "sequence_conv",
+                   "sequence_reshape", "sequence_erase", "sequence_slice"}
+
+
 def _seq_inputs(helper, x, extra=None):
-    if getattr(x, "lod_level", 0) >= 2:
-        # wiring only the outer counts would silently mask the SENTENCE
-        # axis as if it were time — refuse instead (reference sequence ops
-        # act on the innermost level; here only sequence_pool implements
-        # that; pool the inner level first)
+    # sequence ops act on the INNERMOST LoD level (reference
+    # lod_tensor.h:110): for nested (level-2) inputs the wired companion
+    # is the [B, S] inner lengths; the op rules flatten (doc, sentence)
+    # rows, run the level-1 semantics, and restore the nesting
+    if (getattr(x, "lod_level", 0) >= 2
+            and helper.layer_type not in _NESTED_CAPABLE):
         raise NotImplementedError(
-            f"{helper.layer_type}: nested (level-2) LoD input is only "
-            f"supported by sequence_pool — pool the inner level first")
+            f"{helper.layer_type}: nested (level-2) LoD input is supported "
+            f"by {sorted(_NESTED_CAPABLE)}; pool the inner level first")
     inputs = {"X": [x.name]}
-    seq = helper.ensure_seqlen_var(x)
+    level = max(getattr(x, "lod_level", 0) - 1, 0)
+    seq = helper.ensure_seqlen_var(x, level=level)
     if seq is not None:
         inputs["SeqLen"] = [seq.name]
     if extra:
@@ -650,14 +666,16 @@ def _alias_seqlen(helper, src, dst):
     their input's @SEQLEN onto the output with an explicit assign — the
     runtime propagation in lowering.py only walks propagate_seqlen=True ops,
     and a downstream sequence op would otherwise read an unmaterialized
-    companion."""
-    seq_src = helper.ensure_seqlen_var(src)
-    if seq_src is None:
-        return
+    companion. All LoD levels are aliased (outer doc counts AND inner
+    sentence lengths for nested inputs)."""
     dst.lod_level = max(dst.lod_level, src.lod_level)
-    seq_dst = helper.ensure_seqlen_var(dst)
-    helper.append_op("assign", inputs={"X": [seq_src.name]},
-                     outputs={"Out": [seq_dst.name]})
+    for level in range(dst.lod_level):
+        seq_src = helper.ensure_seqlen_var(src, level=level)
+        if seq_src is None:
+            continue
+        seq_dst = helper.ensure_seqlen_var(dst, level=level)
+        helper.append_op("assign", inputs={"X": [seq_src.name]},
+                         outputs={"Out": [seq_dst.name]})
 
 
 def sequence_pool(input, pool_type, is_test=False):
@@ -756,10 +774,17 @@ def sequence_reshape(input, new_dim):
     outputs = {"Out": [out.name]}
     if input.lod_level > 0:
         # lengths scale by D/new_dim — emitted by the op itself (OutLen)
-        seq_out = helper.ensure_seqlen_var(out)
+        # onto the INNERMOST companion; outer doc counts ride through
+        seq_out = helper.ensure_seqlen_var(out, level=input.lod_level - 1)
         outputs["OutLen"] = [seq_out.name]
     helper.append_op("sequence_reshape", inputs=_seq_inputs(helper, input),
                      outputs=outputs, attrs={"new_dim": new_dim})
+    for level in range(input.lod_level - 1):
+        src = helper.ensure_seqlen_var(input, level=level)
+        if src is not None:
+            dst = helper.ensure_seqlen_var(out, level=level)
+            helper.append_op("assign", inputs={"X": [src.name]},
+                             outputs={"Out": [dst.name]})
     return out
 
 
@@ -1209,13 +1234,21 @@ def sequence_slice(input, offset, length, name=None):
     helper.append_op("sequence_slice",
                      inputs={"X": [input.name], "Offset": [offset.name],
                              "Length": [length.name]},
-                     outputs={"Out": [out.name], "OutLen": [lens.name]})
+                     outputs={"Out": [out.name], "OutLen": [lens.name]},
+                     attrs={"nested": input.lod_level >= 2})
     out.lod_level = max(input.lod_level, 1)
     blk = helper.main_program.current_block()
-    comp = blk.create_var(name=seqlen_var_name(out.name), shape=[-1],
-                          dtype="int32")
+    inner = out.lod_level - 1
+    comp = blk.create_var(name=seqlen_var_name(out.name, inner),
+                          shape=[-1] * (inner + 1), dtype="int32")
     helper.append_op("assign", inputs={"X": [lens.name]},
                      outputs={"Out": [comp.name]})
+    for level in range(inner):      # outer doc counts ride through
+        src = helper.ensure_seqlen_var(input, level=level)
+        if src is not None:
+            dst = helper.ensure_seqlen_var(out, level=level)
+            helper.append_op("assign", inputs={"X": [src.name]},
+                             outputs={"Out": [dst.name]})
     return out
 
 
@@ -1232,8 +1265,15 @@ def sequence_erase(input, tokens, name=None):
                      attrs={"tokens": [int(t) for t in tokens]})
     out.lod_level = max(input.lod_level, 1)
     blk = helper.main_program.current_block()
-    comp = blk.create_var(name=seqlen_var_name(out.name), shape=[-1],
-                          dtype="int32")
+    inner = out.lod_level - 1
+    comp = blk.create_var(name=seqlen_var_name(out.name, inner),
+                          shape=[-1] * (inner + 1), dtype="int32")
     helper.append_op("assign", inputs={"X": [lens.name]},
                      outputs={"Out": [comp.name]})
+    for level in range(inner):      # outer doc counts ride through
+        src = helper.ensure_seqlen_var(input, level=level)
+        if src is not None:
+            dst = helper.ensure_seqlen_var(out, level=level)
+            helper.append_op("assign", inputs={"X": [src.name]},
+                             outputs={"Out": [dst.name]})
     return out

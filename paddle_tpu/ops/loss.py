@@ -41,20 +41,24 @@ def _cross_entropy(ctx, X, Label):
 @register_op("softmax_with_cross_entropy")
 def _softmax_with_cross_entropy(ctx, Logits, Label):
     """Numerically-stable fused kernel (reference
-    softmax_with_cross_entropy_op.cc). Outputs Softmax and Loss."""
+    softmax_with_cross_entropy_op.cc). Outputs Softmax, Loss, and the
+    log-sum-exp vector (hidden LSE output — the grad's residual). The
+    hard-label loss reads only the gathered logit, so the full [rows, V]
+    log-softmax never materializes unless the Softmax output is actually
+    consumed (XLA DCEs it otherwise)."""
     logits32 = Logits.astype(jnp.float32)
     lse = jax.scipy.special.logsumexp(logits32, axis=-1, keepdims=True)
-    log_softmax = logits32 - lse
-    softmax = jnp.exp(log_softmax)
+    softmax = jnp.exp(logits32 - lse)
     if ctx.attr("soft_label", False):
-        loss = -jnp.sum(Label * log_softmax, axis=-1, keepdims=True)
+        loss = -jnp.sum(Label * (logits32 - lse), axis=-1, keepdims=True)
     else:
         ids = _squeeze_label(Label).astype(jnp.int32)
-        picked = jnp.take_along_axis(log_softmax, ids[..., None], axis=-1)
-        loss = -picked
+        picked = jnp.take_along_axis(logits32, ids[..., None], axis=-1)
+        loss = lse - picked
         ignore = ctx.attr("ignore_index", -100)
         loss = jnp.where(ids[..., None] == ignore, 0.0, loss)
-    return {"Softmax": softmax.astype(Logits.dtype), "Loss": loss.astype(Logits.dtype)}
+    return {"Softmax": softmax.astype(Logits.dtype),
+            "Loss": loss.astype(Logits.dtype), "LSE": lse}
 
 
 @register_grad("softmax_with_cross_entropy")
@@ -72,14 +76,22 @@ def _swce_grad(ctx, ins, out_grads):
     Logits, Label = ins["Logits"][0], ins["Label"][0]
     gL = out_grads.get("Loss", [None])[0]
     gS = out_grads.get("Softmax", [None])[0]
-    saved = getattr(ctx, "fwd_outs", {}).get("Softmax", [None])[0]
-    if saved is not None and saved.dtype != jnp.float32:
-        # use the saved (bf16/f16 under AMP) probabilities — reference
-        # grad convention. NOT when f32: a live f32 [B*T, V] residual
-        # across the fwd/bwd boundary is the 2 GB allocation that OOM'd
-        # batch 256 in round 3; recompute instead (XLA CSEs it with the
-        # forward when profitable, so this costs nothing when it fuses)
-        softmax = saved.astype(jnp.float32)
+    fwd_outs = getattr(ctx, "fwd_outs", {})
+    saved_lse = fwd_outs.get("LSE", [None])[0]
+    saved_sm = fwd_outs.get("Softmax", [None])[0]
+    if saved_lse is not None:
+        # preferred: the [rows, 1] f32 lse residual — softmax rebuilds as
+        # exp(logits - lse), pure elementwise, fusing into the dLogits
+        # consumers; no [rows, V] reduction re-runs in the backward and
+        # no [rows, V] tensor crosses the fwd/bwd boundary
+        logits32 = Logits.astype(jnp.float32)
+        lse = saved_lse
+        softmax = jnp.exp(logits32 - lse)
+    elif saved_sm is not None and saved_sm.dtype != jnp.float32:
+        # reference grad convention (consume the saved Softmax output) —
+        # but only in a half dtype: a live f32 [rows, V] residual is the
+        # 2 GB allocation that OOM'd batch 256 in round 3
+        softmax = saved_sm.astype(jnp.float32)
         logits32 = lse = None
     else:
         logits32 = Logits.astype(jnp.float32)

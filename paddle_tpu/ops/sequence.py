@@ -20,6 +20,20 @@ def _time_mask(SeqLen, T, dtype=jnp.float32):
     return (jnp.arange(T)[None, :] < SeqLen.reshape(-1, 1)).astype(dtype)
 
 
+
+
+def _flat_rows(a):
+    """[B, S, rest...] -> [(B*S), rest...] — the innermost-level adapter:
+    nested (level-2) inputs run the level-1 rule on flattened (doc,
+    sentence) rows (reference lod_tensor.h:110 — sequence ops act on the
+    innermost LoD level)."""
+    return a.reshape((a.shape[0] * a.shape[1],) + tuple(a.shape[2:]))
+
+
+def _unflat_rows(a, B, S):
+    return a.reshape((B, S) + tuple(a.shape[1:]))
+
+
 @register_op("sequence_pool", propagate_seqlen=False)
 def _sequence_pool(ctx, X, SeqLen=None):
     """[B, T, D] (+lengths) -> [B, D]. pool_type in
@@ -62,6 +76,10 @@ def _sequence_pool(ctx, X, SeqLen=None):
 @register_op("sequence_softmax", propagate_seqlen=False)
 def _sequence_softmax(ctx, X, SeqLen=None):
     """Softmax over the time axis within each row's valid prefix."""
+    if SeqLen is not None and SeqLen.ndim == 2:           # nested LoD
+        B, S = X.shape[0], X.shape[1]
+        out = _sequence_softmax(ctx, _flat_rows(X), SeqLen.reshape(-1))
+        return {"Out": _unflat_rows(out["Out"], B, S)}
     B, T = X.shape[0], X.shape[1]
     L = SeqLen if SeqLen is not None else jnp.full((B,), T, jnp.int32)
     m = _time_mask(L, T, jnp.float32)
@@ -78,6 +96,12 @@ def _sequence_expand(ctx, X, Y, SeqLen=None):
     """Broadcast per-row features over Y's time axis
     (reference sequence_expand_op.cc, ref_level=0 case):
     X [B, D] or [B, 1, D] -> [B, T_y, D]."""
+    if Y.ndim == 4:                                      # nested LoD Y
+        # X per (doc, sentence) row: [B, S, D] -> [B, S, T_y, D]
+        x = X if X.ndim == 4 else X[:, :, None, :]
+        T = Y.shape[2]
+        return {"Out": jnp.broadcast_to(
+            x, (x.shape[0], x.shape[1], T, x.shape[-1]))}
     x = X if X.ndim == 3 else X[:, None, :]
     T = Y.shape[1]
     return {"Out": jnp.broadcast_to(x, (x.shape[0], T, x.shape[-1]))}
@@ -89,6 +113,11 @@ def _sequence_reshape(ctx, X, SeqLen=None):
     D/new_dim (reference sequence_reshape_op.cc recomputes the LoD the same
     way and requires len*D % new_dim == 0)."""
     new_dim = ctx.attr("new_dim")
+    if SeqLen is not None and SeqLen.ndim == 2:           # nested LoD
+        B, S = X.shape[0], X.shape[1]
+        sub = _sequence_reshape(ctx, _flat_rows(X), SeqLen.reshape(-1))
+        return {"Out": _unflat_rows(sub["Out"], B, S),
+                "OutLen": sub["OutLen"].reshape(B, S)}
     B, T, D = X.shape
     assert (T * D) % new_dim == 0
     outs = {"Out": X.reshape(B, (T * D) // new_dim, new_dim)}
@@ -110,6 +139,19 @@ def _sequence_slice(ctx, X, Offset, Length):
     [B, T, ...] layout with OutLen = len_b. Dynamic STARTS are fine under
     XLA (a gather); only dynamic shapes are not — the old raise conflated
     the two."""
+    if ctx.attr("nested", False):
+        # nested LoD (explicit attr from the layer — a shape heuristic
+        # would misread level-1 [B, 1, D] inputs): slice each
+        # (doc, sentence) row independently
+        B, S = X.shape[0], X.shape[1]
+        sub = _slice_rows(_flat_rows(X), Offset.reshape(-1),
+                          Length.reshape(-1))
+        return {"Out": _unflat_rows(sub["Out"], B, S),
+                "OutLen": sub["OutLen"].reshape(B, S)}
+    return _slice_rows(X, Offset, Length)
+
+
+def _slice_rows(X, Offset, Length):
     B, T = X.shape[0], X.shape[1]
     # offsets and lengths clamp to the tensor bound: a compiled XLA
     # program cannot raise on runtime values (the reference kernel
@@ -135,6 +177,11 @@ def _sequence_conv(ctx, X, Filter, SeqLen=None, PaddingData=None):
     X [B, T, D], Filter [ctx_len*D, M] -> [B, T, M]."""
     ctx_len = ctx.attr("contextLength", 3)
     ctx_start = ctx.attr("contextStart", -(ctx_len // 2))
+    if SeqLen is not None and SeqLen.ndim == 2:           # nested LoD
+        B, S = X.shape[0], X.shape[1]
+        sub = _sequence_conv(ctx, _flat_rows(X), Filter,
+                             SeqLen.reshape(-1), PaddingData)
+        return {"Out": _unflat_rows(sub["Out"], B, S)}
     B, T, D = X.shape
     L = SeqLen if SeqLen is not None else jnp.full((B,), T, jnp.int32)
     m = _time_mask(L, T, X.dtype)[..., None]
@@ -160,6 +207,11 @@ def _sequence_erase(ctx, X, SeqLen=None):
     [B, T] — the 'dynamic length' the old raise pointed at lives in the
     lengths companion, exactly like every other sequence op here."""
     tokens = [int(v) for v in (ctx.attr("tokens", []) or [])]
+    if SeqLen is not None and SeqLen.ndim == 2:           # nested LoD
+        B, S = X.shape[0], X.shape[1]
+        sub = _sequence_erase(ctx, _flat_rows(X), SeqLen.reshape(-1))
+        return {"Out": _unflat_rows(sub["Out"], B, S),
+                "OutLen": sub["OutLen"].reshape(B, S)}
     squeeze = X.ndim == 3 and X.shape[-1] == 1   # Paddle ids are often [B,T,1]
     ids = X.reshape(X.shape[0], X.shape[1]) if squeeze else X
     B, T = ids.shape

@@ -177,11 +177,10 @@ class ParallelExecutor:
                                         mesh=self._mesh)
             self._cache[key] = compiled
 
-        if self._program.random_seed is not None:
-            counter = np.uint32(0)   # seeded = deterministic (see Executor.run)
-        else:
-            counter = np.uint32(self._run_counter)
-            self._run_counter += 1
+        # per-program run counter (see Executor.run): deterministic
+        # trajectories from seeded init, per-step mask variation
+        counter = np.uint32(self._run_counter)
+        self._run_counter += 1
         fetches = compiled.run(self._scope, feed_arrays, counter)
         if return_numpy:
             fetches = [self._fetch_numpy(f) for f in fetches]

@@ -8,7 +8,8 @@ bound via pybind recordio.cc).
 Layout per chunk (all u32 little-endian, matching the reference header
 fields): MAGIC, num_records, checksum (crc32 of the payload), compressor,
 payload_size, then the payload = concatenated [u32 length | bytes]
-records. Compressor 0 = none, 2 = gzip (zlib); snappy (1) is not vendored.
+records. Compressor 0 = none, 1 = snappy (pure-python codec in
+snappy_codec.py: full decoder, literal-only encoder), 2 = gzip (zlib).
 The byte-level hot path (checksum + record splitting) runs in a small C++
 library (native.cc) compiled lazily with g++; a pure-python fallback keeps
 the format usable without a toolchain."""
@@ -28,7 +29,7 @@ logger = logging.getLogger(__name__)
 
 MAGIC = 0x01020304
 NO_COMPRESS = 0
-SNAPPY = 1      # recognised but unsupported (reference vendored snappy)
+SNAPPY = 1      # reference vendored C snappy; here snappy_codec.py
 GZIP = 2
 
 _HDR = struct.Struct("<IIIII")   # magic, num_records, checksum, comp, size
@@ -109,6 +110,9 @@ def _write_chunk(fo, records: List[bytes], compressor: int):
     checksum = _crc32(payload)
     if compressor == GZIP:
         payload = zlib.compress(payload)
+    elif compressor == SNAPPY:
+        from . import snappy_codec
+        payload = snappy_codec.compress(payload)
     elif compressor != NO_COMPRESS:
         raise ValueError(f"unsupported compressor {compressor}")
     fo.write(_HDR.pack(MAGIC, len(records), checksum, compressor,
@@ -130,6 +134,9 @@ def _read_chunk(fi) -> Optional[List[bytes]]:
         raise IOError("recordio: truncated chunk payload")
     if comp == GZIP:
         payload = zlib.decompress(payload)
+    elif comp == SNAPPY:
+        from . import snappy_codec
+        payload = snappy_codec.decompress(payload)
     elif comp != NO_COMPRESS:
         raise IOError(f"recordio: unsupported compressor {comp}")
     if _crc32(payload) != checksum:

@@ -1,0 +1,127 @@
+"""Pure-Python snappy codec for RecordIO chunk payloads.
+
+The reference vendors Google snappy for its RecordIO compressor code 1
+(reference: paddle/fluid/recordio/header.h:25 kSnappy, chunk.cc). This
+build has no snappy wheel and zero egress, so the format is implemented
+directly from the public framing spec:
+
+- ``decompress`` is a COMPLETE decoder (literals + all three copy-element
+  forms, including overlapping copies), so chunk payloads written by the
+  reference's real snappy round-trip into this reader.
+- ``compress`` emits spec-compliant literal-only streams: valid snappy
+  that any decoder (including the reference's) reads back; it trades the
+  size win for zero vendored C code. Use GZIP when on-disk size matters.
+"""
+
+from __future__ import annotations
+
+
+class SnappyError(IOError):
+    pass
+
+
+def _read_varint32(buf: bytes, pos: int):
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(buf):
+            raise SnappyError("snappy: truncated varint")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result & 0xFFFFFFFF, pos
+        shift += 7
+        if shift > 32:
+            raise SnappyError("snappy: varint too long")
+
+
+def _write_varint32(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def decompress(buf: bytes) -> bytes:
+    """Full snappy raw-format decoder."""
+    expected, pos = _read_varint32(buf, 0)
+    out = bytearray()
+    n = len(buf)
+    while pos < n:
+        tag = buf[pos]
+        pos += 1
+        kind = tag & 0x03
+        if kind == 0:                       # literal
+            ln = tag >> 2
+            if ln >= 60:                    # 60..63: length in next 1..4 bytes
+                nbytes = ln - 59
+                if pos + nbytes > n:
+                    raise SnappyError("snappy: truncated literal length")
+                ln = int.from_bytes(buf[pos:pos + nbytes], "little")
+                pos += nbytes
+            ln += 1
+            if pos + ln > n:
+                raise SnappyError("snappy: truncated literal")
+            out += buf[pos:pos + ln]
+            pos += ln
+            continue
+        if kind == 1:                       # copy, 1-byte offset
+            ln = ((tag >> 2) & 0x07) + 4
+            if pos >= n:
+                raise SnappyError("snappy: truncated copy-1")
+            off = ((tag >> 5) << 8) | buf[pos]
+            pos += 1
+        elif kind == 2:                     # copy, 2-byte offset
+            ln = (tag >> 2) + 1
+            if pos + 2 > n:
+                raise SnappyError("snappy: truncated copy-2")
+            off = int.from_bytes(buf[pos:pos + 2], "little")
+            pos += 2
+        else:                               # copy, 4-byte offset
+            ln = (tag >> 2) + 1
+            if pos + 4 > n:
+                raise SnappyError("snappy: truncated copy-4")
+            off = int.from_bytes(buf[pos:pos + 4], "little")
+            pos += 4
+        if off == 0 or off > len(out):
+            raise SnappyError("snappy: invalid copy offset")
+        # overlapping copies are byte-at-a-time by spec
+        start = len(out) - off
+        if off >= ln:
+            out += out[start:start + ln]
+        else:
+            for i in range(ln):
+                out.append(out[start + i])
+    if len(out) != expected:
+        raise SnappyError(
+            f"snappy: length mismatch (got {len(out)}, expected {expected})")
+    return bytes(out)
+
+
+_MAX_LITERAL = 1 << 16
+
+
+def compress(buf: bytes) -> bytes:
+    """Literal-only snappy encoder (valid for any decoder)."""
+    out = bytearray(_write_varint32(len(buf)))
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        ln = min(_MAX_LITERAL, n - pos)
+        if ln <= 60:
+            out.append((ln - 1) << 2)
+        elif ln <= 0x100:
+            out.append(60 << 2)
+            out += (ln - 1).to_bytes(1, "little")
+        else:
+            out.append(61 << 2)
+            out += (ln - 1).to_bytes(2, "little")
+        out += buf[pos:pos + ln]
+        pos += ln
+    return bytes(out)

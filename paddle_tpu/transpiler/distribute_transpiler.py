@@ -73,14 +73,24 @@ class DistributeTranspiler:
         self.grad_names: Dict[str, str] = {}     # param -> grad var name
 
     def transpile(self, trainer_id, program=None, pservers="", trainers=1,
-                  sync_mode=True, startup_program=None):
+                  sync_mode=True, startup_program=None, mode=None):
+        """mode="hybrid" is the reference's nccl2 + distributed-lookup-table
+        composition (P4+P5, the CTR recipe): DENSE parameters keep their
+        in-graph optimizer ops — their gradients synchronize through GSPMD
+        collectives over the mesh — while distributed lookup tables go to
+        the host parameter servers (prefetch + sparse push)."""
         self._trainer_id = trainer_id
         self._trainers = trainers if isinstance(trainers, int) \
             else len(trainers.split(","))
         self._program = program or ir.default_main_program()
         self._pserver_endpoints = [e for e in pservers.split(",") if e]
-        self.sync_mode = sync_mode
-        if sync_mode:
+        self._hybrid = mode == "hybrid"
+        self.sync_mode = sync_mode and not self._hybrid
+        if self._hybrid:
+            if not self._pserver_endpoints:
+                raise ValueError("hybrid mode needs pservers='host:port,...'")
+            self._build_async_plan(dense_local=True)
+        elif sync_mode:
             self._annotate_distributed_tables()
         else:
             if not self._pserver_endpoints:
@@ -94,7 +104,7 @@ class DistributeTranspiler:
     # updates, no barriers; trainer send/recv become host-side phases
     # around the jitted step, pserver/client.py)
     # ------------------------------------------------------------------
-    def _build_async_plan(self):
+    def _build_async_plan(self, dense_local=False):
         block = self._program.global_block()
         dispatcher = self.config.split_method(self._pserver_endpoints)
 
@@ -125,6 +135,9 @@ class DistributeTranspiler:
                 spec["ids_names"].append(ids_name)
 
         # 2. find + strip optimizer ops; record per-param server specs.
+        # hybrid (dense_local): dense optimizer ops STAY in the program
+        # (GSPMD collectives synchronize their grads); only the sparse
+        # tables' updates move server-side.
         keep_ops = []
         for op in block.ops:
             if op.type not in OPTIMIZE_OP_TYPES:
@@ -133,11 +146,15 @@ class DistributeTranspiler:
             pname = op.input("Param")[0]
             gname = op.input("Grad")[0]
             lr_name = (op.input("LearningRate") or [None])[0]
-            self.grad_names[pname] = gname
             if pname in sparse_params:
+                self.grad_names[pname] = gname
                 self.sparse_specs[pname].update(
                     opt_type=op.type, lr_name=lr_name, attrs=dict(op.attrs))
                 continue  # table updates go through push_sparse_grad
+            if dense_local:
+                keep_ops.append(op)
+                continue
+            self.grad_names[pname] = gname
             self.param_specs[pname] = {
                 "opt_type": op.type, "lr_name": lr_name,
                 "attrs": dict(op.attrs),

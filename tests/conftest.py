@@ -35,6 +35,32 @@ def pytest_collection_modifyitems(config, items):
             if not any(t in path for t in ("test_flash_dropout_tpu",
                                            "test_long_context_tpu")):
                 item.add_marker(skip)
+    # under pytest-xdist, serialize each subprocess-spawning file into one
+    # worker (`--dist loadgroup`): they fork whole jax worlds / embedded
+    # interpreters and oversubscribe badly when co-scheduled
+    # pserver/dist tests bind ephemeral ports (":0") and are parallel-
+    # safe; only the files that spawn whole jax WORLDS or embedded
+    # interpreters stay serialized
+    heavy = ("test_multihost", "test_capi")
+    for item in items:
+        path = str(item.fspath)
+        for h in heavy:
+            if h in path:
+                item.add_marker(pytest.mark.xdist_group(h))
+                break
+        # both TPU-gated files share ONE group: two processes compiling
+        # through the axon compile server concurrently can crash it
+        if "_tpu" in path:
+            item.add_marker(pytest.mark.xdist_group("tpu"))
+    # schedule the compile-heavy tests FIRST so a late-starting 300s test
+    # can't extend the tail (xdist pops in collection order)
+    heavy_tests = ("test_resnet50_trains", "test_se_resnext_trains",
+                   "test_mp_sp_parity", "test_mp_parity",
+                   "test_ring_attention_via_parallel_executor",
+                   "test_resnet_space_to_depth_stem", "test_vgg16_trains",
+                   "test_async_pserver_deepfm_two_trainers")
+    items.sort(key=lambda it: 0 if any(h in it.name for h in heavy_tests)
+               else 1)
 
 
 @pytest.fixture(autouse=True)

@@ -177,6 +177,8 @@ int main(int argc, char** argv) {
                + os.environ.get("LD_LIBRARY_PATH", ""),
                JAX_PLATFORMS="cpu")
     # a GIL deadlock would hang forever: the timeout IS the assertion
-    proc = subprocess.run([exe, model_dir], env=env, timeout=120,
+    # (generous: under `pytest -n` the embedded interpreter's jax import
+    # + CPU compile competes with every other worker for cores)
+    proc = subprocess.run([exe, model_dir], env=env, timeout=420,
                           capture_output=True, text=True)
     assert proc.returncode == 0, (proc.stdout, proc.stderr)

@@ -121,10 +121,106 @@ def test_nested_lod_through_embedding_and_pe():
         assert np.isfinite(np.asarray(lp)).all()
 
 
-def test_non_pool_sequence_ops_reject_nested_input():
-    x = layers.data(name="x2", shape=[3], dtype="float32", lod_level=2)
-    with pytest.raises(NotImplementedError, match="nested"):
-        layers.sequence_softmax(x)
+def test_nested_inner_level_softmax_semantics():
+    """sequence_softmax on a level-2 input normalizes each SENTENCE's
+    valid prefix independently (reference: sequence ops act on the
+    innermost level, sequence_softmax_op.cc)."""
+    x = layers.data(name="x2", shape=[-1, -1, -1], dtype="float32",
+                    lod_level=2, append_batch_size=False)
+    sm = layers.sequence_softmax(x)
+    assert sm.lod_level == 2
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    data = np.array([1, 2, 3, 4, 5], np.float32).reshape(5, 1)
+    padded, lens = fluid.create_lod_tensor(data, [[2, 1], [2, 1, 2]])
+    got = np.asarray(exe.run(feed={"x2": (padded[..., 0], lens)},
+                             fetch_list=[sm])[0])
+    # doc0 sent0 = softmax([1,2]); sent1 = softmax([3]) = [1]
+    e = np.exp([1.0, 2.0]); e /= e.sum()
+    np.testing.assert_allclose(got[0, 0], e, rtol=1e-6)
+    np.testing.assert_allclose(got[0, 1, 0], 1.0, rtol=1e-6)
+    # doc1 sent0 = softmax([4,5]); padding positions stay 0
+    e2 = np.exp([4.0, 5.0]); e2 /= e2.sum()
+    np.testing.assert_allclose(got[1, 0], e2, rtol=1e-6)
+    np.testing.assert_allclose(got[1, 1], [0, 0], atol=0)
+
+
+def test_nested_inner_level_pipeline_trains():
+    """A level-2 pipeline through >=3 inner-level ops (conv -> softmax
+    gate -> pool -> pool) TRAINS — the round-4 verdict's acceptance bar
+    for nested-LoD generality."""
+    x = layers.data(name="xp", shape=[2], dtype="float32", lod_level=2)
+    y = layers.data(name="yp", shape=[1], dtype="int64")
+    conv = layers.sequence_conv(x, num_filters=4, filter_size=3)
+    assert conv.lod_level == 2
+    gate = layers.sequence_softmax(conv)          # inner-level softmax
+    sent = layers.sequence_pool(gate, "sum")      # [B, S, 4]
+    doc = layers.sequence_pool(sent, "average")   # [B, 4]
+    p = layers.fc(input=doc, size=2, act="softmax")
+    loss = layers.mean(layers.cross_entropy(input=p, label=y))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+
+    feeder = fluid.DataFeeder(feed_list=[x, y], place=fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    samples = []
+    for i in range(16):
+        label = i % 2
+        doc_data = [[list(rng.uniform(label, label + 1.0, 2))
+                     for _ in range(rng.randint(2, 5))]
+                    for _ in range(rng.randint(1, 4))]
+        samples.append((doc_data, label))
+    feed = feeder.feed(samples)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = [float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0]))
+              for _ in range(50)]
+    assert losses[-1] < losses[0] * 0.75, losses[::10]
+
+
+def test_nested_inner_level_erase_and_reshape():
+    """sequence_erase and sequence_reshape act on the innermost level,
+    with inner lengths updated and outer counts preserved."""
+    from paddle_tpu.core.ir import seqlen_var_name
+    prog, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, start), fluid.unique_name.guard():
+        ids = layers.data(name="ids2", shape=[-1, -1, -1], dtype="int64",
+                          lod_level=2, append_batch_size=False)
+        erased = layers.sequence_erase(ids, tokens=[0])
+        assert erased.lod_level == 2
+    prog2, start2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog2, start2), fluid.unique_name.guard():
+        xr = layers.data(name="xr", shape=[-1, -1, -1, 4], dtype="float32",
+                         lod_level=2, append_batch_size=False)
+        rs = layers.sequence_reshape(xr, new_dim=2)
+        assert rs.lod_level == 2
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(start, scope=scope)
+    exe.run(start2, scope=scope)
+
+    # erase: doc0 sents [1,0,2] and [0,3]; doc1 sent [4]
+    data = np.array([1, 0, 2, 0, 3, 4], np.int64).reshape(6, 1)
+    padded, lens = fluid.create_lod_tensor(data, [[2, 1], [3, 2, 1]])
+    got, inner = exe.run(
+        prog, feed={"ids2": (padded[..., 0], lens)},
+        fetch_list=[erased, seqlen_var_name(erased.name, 1)], scope=scope)
+    got, inner = np.asarray(got), np.asarray(inner)
+    np.testing.assert_array_equal(inner, [[2, 1], [1, 0]])
+    np.testing.assert_array_equal(got[0, 0, :2], [1, 2])
+    np.testing.assert_array_equal(got[0, 1, :1], [3])
+    np.testing.assert_array_equal(got[1, 0, :1], [4])
+
+    # reshape: [B,S,T,4] -> [B,S,2T,2], inner lengths double
+    xdat = np.arange(2 * 2 * 3 * 4, dtype=np.float32).reshape(2, 2, 3, 4)
+    outer = np.array([2, 1], np.int32)
+    il = np.array([[3, 2], [1, 0]], np.int32)
+    got_rs, inner_rs = exe.run(
+        prog2, feed={"xr": (xdat, (outer, il))},
+        fetch_list=[rs, seqlen_var_name(rs.name, 1)], scope=scope)
+    got_rs, inner_rs = np.asarray(got_rs), np.asarray(inner_rs)
+    assert got_rs.shape == (2, 2, 6, 2)
+    np.testing.assert_array_equal(inner_rs, [[6, 4], [2, 0]])
+    np.testing.assert_allclose(got_rs[0, 0].reshape(-1), xdat[0, 0].reshape(-1))
 
 
 def test_create_lod_tensor_nested_list_forms():

@@ -21,6 +21,8 @@ from paddle_tpu.parallel.parallel_executor import BuildStrategy
 
 STEPS = 3
 
+_REF_CACHE = {}
+
 
 def _build(optimizer=None, dropout=0.0, fused=True):
     main, startup = fluid.Program(), fluid.Program()
@@ -44,13 +46,21 @@ def _batches(n=STEPS):
     return out
 
 
-def _single_device_losses(main, startup, loss, batches):
+def _single_device_losses(main, startup, loss, batches, cache_key=None):
+    # the single-device reference trajectory is identical across tests
+    # that share a build config (seeded init + same batches) — cache it;
+    # re-deriving it per test costs a full CPU compile
+    if cache_key is not None and cache_key in _REF_CACHE:
+        return _REF_CACHE[cache_key]
     scope = fluid.Scope()
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup, scope=scope)
-    return [float(np.asarray(exe.run(main, feed=b, fetch_list=[loss],
-                                     scope=scope)[0]))
-            for b in batches]
+    out = [float(np.asarray(exe.run(main, feed=b, fetch_list=[loss],
+                                    scope=scope)[0]))
+           for b in batches]
+    if cache_key is not None:
+        _REF_CACHE[cache_key] = out
+    return out
 
 
 def _pe_losses(main, startup, loss, batches, mesh, build_strategy=None):
@@ -76,7 +86,8 @@ def test_mp_parity_dp2_mp4():
     _needs8()
     main, startup, loss = _build()
     batches = _batches()
-    ref = _single_device_losses(main, startup, loss, batches)
+    ref = _single_device_losses(main, startup, loss, batches,
+                                cache_key="sgd")
     m = mesh_lib.make_mesh([2, 4], ["dp", "mp"])
     pe, scope, got = _pe_losses(main, startup, loss, batches, m)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
@@ -93,7 +104,8 @@ def test_mp_sp_parity_dp2_mp2_sp2():
     _needs8()
     main, startup, loss = _build()
     batches = _batches()
-    ref = _single_device_losses(main, startup, loss, batches)
+    ref = _single_device_losses(main, startup, loss, batches,
+                                cache_key="sgd")
     m = mesh_lib.make_mesh([2, 2, 2], ["dp", "mp", "sp"])
     _, _, got = _pe_losses(main, startup, loss, batches, m)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
@@ -107,7 +119,8 @@ def test_reduce_strategy_parity_and_sharded_state():
     opt = fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9)
     main, startup, loss = _build(optimizer=opt)
     batches = _batches()
-    ref = _single_device_losses(main, startup, loss, batches)
+    ref = _single_device_losses(main, startup, loss, batches,
+                                cache_key="momentum")
 
     bs = BuildStrategy()
     bs.reduce_strategy = BuildStrategy.ReduceStrategy.Reduce
@@ -129,20 +142,17 @@ def test_reduce_strategy_parity_and_sharded_state():
     assert sharded, "no velocity accumulator carries a ('dp', ...) sharding"
 
 
-def test_reduce_strategy_matches_allreduce_mode():
-    """Both ReduceStrategy modes agree with each other step for step
-    (reference tests exercise both, test_parallel_executor_*)."""
+def test_allreduce_mode_matches_reference():
+    """AllReduce mode (the default) agrees with the single-device
+    trajectory — together with test_reduce_strategy_parity this proves
+    the two ReduceStrategy modes agree with EACH OTHER transitively
+    (reference test_parallel_executor_* exercises both modes)."""
     _needs8()
     opt = fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9)
     main, startup, loss = _build(optimizer=opt)
     batches = _batches()
+    ref = _single_device_losses(main, startup, loss, batches,
+                                cache_key="momentum")
     m = mesh_lib.make_mesh([8], ["dp"])
     _, _, ar = _pe_losses(main, startup, loss, batches, m)
-
-    opt2 = fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9)
-    main2, startup2, loss2 = _build(optimizer=opt2)
-    bs = BuildStrategy()
-    bs.reduce_strategy = BuildStrategy.ReduceStrategy.Reduce
-    _, _, rd = _pe_losses(main2, startup2, loss2, batches, m,
-                          build_strategy=bs)
-    np.testing.assert_allclose(rd, ar, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(ar, ref, rtol=2e-4, atol=2e-5)
