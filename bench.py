@@ -248,7 +248,10 @@ def tpu_gated_tests():
         env = dict(os.environ, PADDLE_TPU_TEST_ON_TPU="1")
         out = subprocess.run(
             [sys.executable, "-m", "pytest", "tests/test_flash_dropout_tpu.py",
-             "tests/test_long_context_tpu.py", "-q", "--no-header"],
+             "tests/test_long_context_tpu.py", "-q", "--no-header",
+             # serial: xdist workers would each hold the one TPU and race
+             # the compile server
+             "-o", "addopts=", "-p", "no:xdist"],
             capture_output=True, text=True, timeout=900, env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)))
         tail = out.stdout.strip().splitlines()[-1] if out.stdout else "no output"

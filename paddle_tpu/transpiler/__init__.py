@@ -2,5 +2,6 @@
 
 from .memory_optimization_transpiler import memory_optimize, release_memory  # noqa: F401
 from .inference_transpiler import InferenceTranspiler  # noqa: F401
+from .float16_transpiler import Float16Transpiler  # noqa: F401
 from .distribute_transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from .ps_dispatcher import RoundRobin, HashName  # noqa: F401

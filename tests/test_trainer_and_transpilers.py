@@ -172,3 +172,46 @@ def test_model_average_matches_window_simulation():
     np.testing.assert_allclose(applied, expected, rtol=1e-5)
     np.testing.assert_allclose(restored, live, rtol=0)
     assert not np.allclose(applied, live)
+
+
+def test_float16_transpiler_inference_parity(tmp_path):
+    """Float16Transpiler (reference paddle/contrib/float16/
+    float16_transpiler.py): a saved f32 inference program re-typed to
+    bfloat16 — params stored half, fed inputs boundary-cast — predicts
+    within half-precision tolerance of the f32 original."""
+    import jax.numpy as jnp
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        h = layers.fc(input=x, size=32, act="relu")
+        h = layers.batch_norm(input=h, is_test=True)
+        pred = layers.fc(input=h, size=4, act="softmax")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    xv = rng.randn(16, 8).astype(np.float32)
+
+    d = str(tmp_path / "m")
+    with fluid.scope_guard(scope):
+        fluid.io.save_inference_model(d, ["x"], [pred], exe,
+                                      main_program=main)
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog2, feeds, fetches = fluid.io.load_inference_model(d, exe)
+    ref = np.asarray(exe.run(prog2, feed={"x": xv}, fetch_list=fetches,
+                             scope=scope2)[0])
+
+    t = fluid.transpiler.Float16Transpiler()
+    t.transpile(prog2, scope=scope2, dtype="bfloat16")
+    # params really stored half
+    halves = [n for n in scope2.local_var_names()
+              if hasattr(scope2.find_var(n), "dtype")
+              and jnp.dtype(scope2.find_var(n).dtype) == jnp.bfloat16]
+    assert halves, "no parameter was converted to bfloat16"
+    got = np.asarray(exe.run(prog2, feed={"x": xv}, fetch_list=fetches,
+                             scope=scope2)[0]).astype(np.float32)
+    np.testing.assert_allclose(got, ref, atol=2e-2)
+    # ranking preserved (the inference quantity that matters)
+    np.testing.assert_array_equal(got.argmax(1), ref.argmax(1))

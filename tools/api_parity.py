@@ -55,6 +55,7 @@ MODULES = [
     ("default_scope_funcs.py", "default_scope_funcs"),
     ("recordio_writer.py", "recordio_writer"),
     ("concurrency.py", None),         # every export waived (retired)
+    ("contrib/decoder/beam_search_decoder.py", "contrib.decoder"),
     # python/paddle top-level modules (outside fluid/)
     ("../reader/decorator.py", "reader"),
     ("../reader/creator.py", "reader.creator"),
