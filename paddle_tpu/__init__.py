@@ -33,6 +33,9 @@ from . import evaluator  # noqa: F401
 from . import average  # noqa: F401
 from . import annotations  # noqa: F401
 from . import contrib  # noqa: F401
+from . import graphviz  # noqa: F401
+from . import net_drawer  # noqa: F401
+from . import op  # noqa: F401
 from . import default_scope_funcs  # noqa: F401
 from . import recordio_writer  # noqa: F401
 from .recordio_writer import (convert_reader_to_recordio_file,  # noqa: F401

@@ -1,0 +1,82 @@
+"""Standalone single-op construction + execution (reference
+python/paddle/fluid/op.py: OperatorFactory / `Operator` — the low-level
+handle the reference's OpTest unit tests drive ops with).
+
+TPU-native redesign: instead of building a C++ OpDesc and dispatching a
+kernel, the returned Operator binds scope variable names to the op's
+lowering rule and `run(scope, place)` executes it eagerly through jax —
+the same rule the compiled whole-program path uses, so a value checked
+here is the value the fused step computes."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from .core import registry
+
+
+class Operator:
+    """`Operator("scale", X="x", Out="y", scale=2.0)`; slots bind scope
+    var NAMES (a list for multi-var slots), everything else is an attr.
+    `run(scope, place)` reads inputs from the scope, applies the lowering
+    rule, and writes the outputs back (reference op.py usage)."""
+
+    def __init__(self, type, **kwargs):
+        if not registry.is_registered(type):
+            raise ValueError(f"The operator: {type} is not registered.")
+        self.type = type
+        opdef = registry.get_op_def(type)
+        in_slots = set(opdef.input_slots)
+        self.inputs: Dict[str, List[str]] = {}
+        self.outputs: Dict[str, List[str]] = {}
+        self.attrs: Dict[str, object] = {}
+        for key, val in kwargs.items():
+            if key in in_slots:
+                self.inputs[key] = list(val) if isinstance(
+                    val, (list, tuple)) else [val]
+            elif key[:1].isupper():
+                # capitalized non-input slot = output name binding (the
+                # reference resolves against the op proto's output list;
+                # the lowering registry discovers outputs at run time)
+                self.outputs[key] = list(val) if isinstance(
+                    val, (list, tuple)) else [val]
+            else:
+                self.attrs[key] = val
+
+    def input_names(self):
+        return list(self.inputs)
+
+    def output_names(self):
+        return list(self.outputs)
+
+    def run(self, scope, place=None):
+        import jax
+
+        opdef = registry.get_op_def(self.type)
+        ins = {}
+        for slot, names in self.inputs.items():
+            vals = []
+            for n in names:
+                v = scope.find_var(n)
+                if v is None:
+                    raise KeyError(f"op {self.type}: input var {n!r} not "
+                                   f"found in scope")
+                vals.append(v)
+            ins[slot] = vals
+        ctx = registry.LoweringContext(self.attrs, key=jax.random.key(0))
+        outs = registry.call_rule(opdef, ctx, ins)
+        for slot, names in self.outputs.items():
+            produced = outs.get(slot)
+            if produced is None:
+                continue
+            vals = produced if isinstance(produced, (list, tuple)) \
+                else [produced]
+            if len(vals) != len(names):
+                raise ValueError(
+                    f"op {self.type}: slot {slot} produced {len(vals)} "
+                    f"value(s) but {len(names)} name(s) were bound")
+            for name, val in zip(names, vals):
+                scope.set_var(name, np.asarray(val))
+        return outs

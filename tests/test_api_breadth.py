@@ -627,3 +627,40 @@ def test_sequence_slice_erase_layers_companion_flow():
                                rtol=1e-6)
     np.testing.assert_allclose(np.asarray(pooled)[1], xv[1, 0:3].sum(0),
                                rtol=1e-6)
+
+
+def test_support_utils_graphviz_net_drawer_op():
+    """The reference's support utilities (graphviz.py dot builder,
+    net_drawer.draw_graph, op.Operator single-op runner — reference
+    §2.8 support row) exist and work."""
+    from paddle_tpu.graphviz import Graph, GraphPreviewGenerator
+    from paddle_tpu import net_drawer
+    from paddle_tpu.op import Operator
+
+    g = Graph("t", rankdir="TB")
+    a = g.node("a", prefix="op")
+    b = g.node("b", prefix="var")
+    g.edge(a, b, label="Out")
+    code = str(g)
+    assert "digraph" in code and "->" in code and 'label="Out"' in code
+
+    gp = GraphPreviewGenerator("prev")
+    n1 = gp.add_op("mul")
+    n2 = gp.add_param("w", "float32")
+    gp.add_edge(n2, n1)
+    assert "mul" in str(gp.graph)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="gx", shape=[4], dtype="float32")
+        layers.fc(input=x, size=2)
+    dg = net_drawer.draw_graph(startup, main)
+    assert "digraph" in dg.code()
+
+    scope = fluid.Scope()
+    scope.set_var("x", np.full((2, 3), 3.0, np.float32))
+    op = Operator("scale", X="x", Out="y", scale=0.5)
+    op.run(scope)
+    np.testing.assert_allclose(np.asarray(scope.find_var("y")), 1.5)
+    with pytest.raises(ValueError, match="not registered"):
+        Operator("no_such_op")
