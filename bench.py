@@ -179,11 +179,15 @@ def bench_transformer(fluid, models, jax, seq_len, batch_size, fused,
 
 
 def bench_stacked_lstm(fluid, models, jax, batch_size=64, seq_len=100,
-                       steps=10, warmup=3):
+                       steps=64, warmup=3):
     """Variable-length RNN path (BASELINE config "Stacked dynamic LSTM
     LM"): 3x512 masked-scan LSTMs with peepholes over padded batches +
     lengths, IMDB-shaped (seq 100, dict 30k — the reference's RNN
-    benchmark config, benchmark/README.md:111)."""
+    benchmark config, benchmark/README.md:111).
+
+    steps=64: the LSTM step is ~1-3 ms of device time, so a short
+    window's slope is tunnel noise (recorded swings of 4x); a 48-step
+    delta puts >100 ms of device time behind the measurement."""
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup), fluid.unique_name.guard():
         feeds, outs = models.stacked_dynamic_lstm.build()
