@@ -520,7 +520,12 @@ def ring_attention(q, k, v, mesh, axis="sp", causal=False, sm_scale=None):
     (SURVEY.md §5.7).
     """
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map          # jax >= 0.8 home
+        _replication_kw = {"check_vma": False}
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+        _replication_kw = {"check_rep": False}
 
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
@@ -569,4 +574,4 @@ def ring_attention(q, k, v, mesh, axis="sp", causal=False, sm_scale=None):
         else None
     spec = P(b_ax, h_ax, axis, None)
     return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_rep=False)(q, k, v)
+                     out_specs=spec, **_replication_kw)(q, k, v)
