@@ -27,6 +27,35 @@ import numpy as np
 
 BASELINE_IMG_PER_SEC = 84.08
 
+# Published claim ranges — the README "Performance" section and
+# docs/PERF.md tables are generated from these, and these are derived
+# ONLY from driver-recorded BENCH_r*.json values plus the current build's
+# measured envelope (round-5 claim-hygiene contract: a published range
+# must contain what the driver records). When a fresh measurement falls
+# outside its range, bench prints a CLAIM-DRIFT warning (fail-soft) so
+# the drift is visible in the recorded tail instead of silently shipping.
+CLAIMS = {
+    "transformer_base_wmt_tokens_per_sec": (210_000, 275_000),
+    "transformer_mfu": (0.42, 0.56),
+    "resnet50_mfu": (0.27, 0.32),
+    "transformer_seq2048_flash_tokens_per_sec": (71_000, 105_000),
+    "flash_vs_unfused_seq4096": (1.40, 1.90),
+    "stacked_lstm_examples_per_sec": (3_500, 15_000),
+    "feeder_overlap_speedup_cpu_demo": (1.3, 2.3),
+}
+
+
+def check_claims(extra, out=sys.stderr):
+    drift = []
+    for k, (lo, hi) in CLAIMS.items():
+        v = extra.get(k)
+        if isinstance(v, (int, float)) and not (lo <= v <= hi):
+            drift.append(k)
+            print(f"CLAIM-DRIFT: {k}={v} outside the published range "
+                  f"[{lo}, {hi}] — re-derive README/docs/PERF.md ranges "
+                  f"from the recorded BENCH_r*.json values", file=out)
+    return drift
+
 
 def _sync(x):
     # axon's block_until_ready is a no-op; force with a host transfer
@@ -296,12 +325,15 @@ def main():
     # MFU for the flash configs reuses the UNFUSED program's XLA-counted
     # FLOPs-per-token: the Pallas kernel is a custom call whose FLOPs XLA
     # cannot see, but the model math per token is identical.
+    # steps=12 (not 8): the 2048 pair is the recorded bench's noisiest
+    # number (r4 recorded 1.26x where same-process measurement gives
+    # ~1.4x) — longer windows put more device time behind each slope
     tok_long_unf, tf2k_fps = bench_transformer(fluid, models, jax,
                                                seq_len=2048, batch_size=8,
-                                               fused=False, steps=8,
+                                               fused=False, steps=12,
                                                warmup=3, want_flops=True)
     tok_long_fus, _ = bench_transformer(fluid, models, jax, seq_len=2048,
-                                        batch_size=8, fused=True, steps=8,
+                                        batch_size=8, fused=True, steps=12,
                                         warmup=3)
     _release(jax)
     flops_per_tok_2k = tf2k_fps / tok_long_unf if tok_long_unf else 0.0
@@ -325,6 +357,30 @@ def main():
     lstm_tok, lstm_ex = bench_stacked_lstm(fluid, models, jax)
     gated = tpu_gated_tests()
 
+    extra = {
+        "vs_baseline_note": "reference best is CPU MKL-DNN bs256; "
+                            "judge MFU fields, not this ratio",
+        "measured_peak_tflops_bf16": round(peak / 1e12, 1),
+        "transformer_mfu": round(tf_fps / peak, 3),
+        "resnet50_mfu": round(rn_fps / peak, 3),
+        "transformer_base_wmt_tokens_per_sec": round(tok_unf, 0),
+        "transformer_base_wmt_tokens_per_sec_flash": round(tok_fus, 0),
+        "transformer_seq2048_flash_tokens_per_sec": round(tok_long_fus, 0),
+        "transformer_seq2048_unfused_tokens_per_sec": round(tok_long_unf, 0),
+        "transformer_seq2048_mfu": round(fus2k_fps / peak, 3),
+        "transformer_seq4096_flash_tokens_per_sec": round(tok_4k_fus, 0),
+        "transformer_seq4096_unfused_tokens_per_sec": round(tok_4k_unf, 0),
+        "flash_vs_unfused_seq4096": round(tok_4k_fus / tok_4k_unf, 2)
+            if tok_4k_unf else 0.0,
+        "feeder_overlap_speedup_cpu_demo":
+            feeder.get("feeder_overlap_speedup_cpu_demo", 0.0),
+        "stacked_lstm_tokens_per_sec": round(lstm_tok, 0),
+        "stacked_lstm_examples_per_sec": round(lstm_ex, 1),
+        "tpu_gated_tests": gated,
+    }
+    drift = check_claims(extra)
+    if drift:
+        extra["claim_drift"] = drift
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(ips, 2),
@@ -334,27 +390,7 @@ def main():
         # construction; the honest chip-efficiency headline is the MFU
         # fields below
         "vs_baseline": round(ips / BASELINE_IMG_PER_SEC, 2),
-        "extra": {
-            "vs_baseline_note": "reference best is CPU MKL-DNN bs256; "
-                                "judge MFU fields, not this ratio",
-            "measured_peak_tflops_bf16": round(peak / 1e12, 1),
-            "transformer_mfu": round(tf_fps / peak, 3),
-            "resnet50_mfu": round(rn_fps / peak, 3),
-            "transformer_base_wmt_tokens_per_sec": round(tok_unf, 0),
-            "transformer_base_wmt_tokens_per_sec_flash": round(tok_fus, 0),
-            "transformer_seq2048_flash_tokens_per_sec": round(tok_long_fus, 0),
-            "transformer_seq2048_unfused_tokens_per_sec": round(tok_long_unf, 0),
-            "transformer_seq2048_mfu": round(fus2k_fps / peak, 3),
-            "transformer_seq4096_flash_tokens_per_sec": round(tok_4k_fus, 0),
-            "transformer_seq4096_unfused_tokens_per_sec": round(tok_4k_unf, 0),
-            "flash_vs_unfused_seq4096": round(tok_4k_fus / tok_4k_unf, 2)
-                if tok_4k_unf else 0.0,
-            "feeder_overlap_speedup_cpu_demo":
-                feeder.get("feeder_overlap_speedup_cpu_demo", 0.0),
-            "stacked_lstm_tokens_per_sec": round(lstm_tok, 0),
-            "stacked_lstm_examples_per_sec": round(lstm_ex, 1),
-            "tpu_gated_tests": gated,
-        },
+        "extra": extra,
     }))
 
 

@@ -145,12 +145,33 @@ def _as_feed_array(v, var: Optional[ir.Variable]):
     return arr
 
 
+def resolve_compiler_options(platform: str):
+    """Per-executable XLA options from the `xla_compiler_options` flag.
+
+    "auto" applies the measured-good TPU set from the round-5 compiler
+    flag sweep (docs/PERF.md): a 32 MiB scoped-VMEM budget lets the
+    fusion merger form larger fusions (fewer HBM round-trips between
+    them) — worth ~9% end-to-end on transformer-base, neutral-to-positive
+    on the other benches. Non-TPU backends get None (the names are
+    TPU-only and other backends reject unknown options)."""
+    from .. import flags as _flags
+
+    val = _flags.get_flag("xla_compiler_options")
+    if val == "auto":
+        if platform == "tpu":
+            return {"xla_tpu_scoped_vmem_limit_kib": "32768"}
+        return None
+    if not val or val == "none":
+        return None
+    return dict(kv.split("=", 1) for kv in val.split(",") if kv)
+
+
 class _CompiledProgram:
     """One lowered+jitted step for a (program version, feed/fetch set)."""
 
     def __init__(self, program: ir.Program, feed_names, fetch_names, scope: Scope,
                  donate: bool, amp: bool = False, check_nan_inf: bool = False,
-                 mesh=None):
+                 mesh=None, compiler_options=None, rng_stream: int = 0):
         self.program = program
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
@@ -202,12 +223,26 @@ class _CompiledProgram:
         self.new_names = [n for n in written if n not in set(read)]
 
         seed = program.random_seed if program.random_seed is not None else 0
+        # unseeded programs additionally fold in their executor-local
+        # ordinal (`rng_stream`): with the per-program run counters, two
+        # distinct unseeded programs run through ONE executor would
+        # otherwise draw IDENTICAL key sequences (fold_in(key(0), 0..n))
+        # and e.g. correlate their dropout masks (round-4 advisor). The
+        # ordinal — not the global program uid — keeps the stream
+        # deterministic for a given executor's usage pattern regardless
+        # of how many programs OTHER code built first. Explicitly seeded
+        # programs keep the pure-counter derivation — that is the
+        # cross-executor reproducibility contract.
+        uid_mix = None if program.random_seed is not None or not rng_stream \
+            else np.uint32(rng_stream)
 
         def step(feeds, mut_state, const_state, counter):
             # key derivation INSIDE the jit: an eager fold_in would
             # dispatch 2-4 tiny device programs per run (visible in the
             # profiler as jit__threefry_* modules), pure host overhead
             key = jax.random.fold_in(jax.random.key(seed), counter)
+            if uid_mix is not None:
+                key = jax.random.fold_in(key, uid_mix)
             env = {}
             env.update(const_state)
             env.update(mut_state)
@@ -224,7 +259,8 @@ class _CompiledProgram:
             return fetches, new_state, flags
 
         donate_args = (1,) if donate else ()
-        self._step = jax.jit(step, donate_argnums=donate_args)
+        self._step = jax.jit(step, donate_argnums=donate_args,
+                             compiler_options=compiler_options or None)
 
     def run(self, scope: Scope, feeds: Dict[str, Any], counter):
         mut = {n: scope.find_var(n) for n in self.mut_names}
@@ -262,6 +298,7 @@ class Executor:
         self._check_nan_inf = check_nan_inf
         self._cache: Dict[tuple, _CompiledProgram] = {}
         self._run_counts: Dict[int, int] = {}  # program uid -> runs so far
+        self._prog_order: Dict[int, int] = {}  # program uid -> ordinal
 
     @property
     def check_nan_inf(self) -> bool:
@@ -328,18 +365,24 @@ class Executor:
                 feed_arrays[name] = _as_feed_array(val, var)
 
         from .. import flags as _flags
+        copts = resolve_compiler_options(self.place.jax_device().platform)
         cache_key = (program._uid, program._version,
                      tuple(sorted(feed_arrays)), tuple(fetch_names),
                      scope._uid, self.amp, self.check_nan_inf,
                      _flags.get_flag("dropout_impl"),
+                     tuple(sorted(copts.items())) if copts else None,
                      program.random_seed)  # seed is baked into the trace
+        stream = self._prog_order.setdefault(program._uid,
+                                             len(self._prog_order))
         compiled = self._cache.get(cache_key) if use_program_cache else None
         if compiled is None:
             with jax.default_device(self.place.jax_device()):
                 compiled = _CompiledProgram(program, sorted(feed_arrays),
                                             fetch_names, scope, donate=True,
                                             amp=self.amp,
-                                            check_nan_inf=self.check_nan_inf)
+                                            check_nan_inf=self.check_nan_inf,
+                                            compiler_options=copts,
+                                            rng_stream=stream)
             if use_program_cache:
                 self._cache[cache_key] = compiled
 

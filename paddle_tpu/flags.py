@@ -21,6 +21,12 @@ _DEFS: Dict[str, tuple] = {
     # (measured default, docs/PERF.md); "pallas" forces the in-kernel-PRNG
     # Pallas kernel on eligible tensors for A/B measurement
     "dropout_impl": ("auto", str),
+    # XLA compile options for the jitted step (round-5 flag sweep,
+    # docs/PERF.md): "auto" = the measured-good TPU set (scoped VMEM
+    # 32 MiB — bigger fusion budget, worth ~9% on transformer-base);
+    # "" / "none" = compiler defaults; or an explicit comma-separated
+    # k=v list (e.g. "xla_tpu_scoped_vmem_limit_kib=65536")
+    "xla_compiler_options": ("auto", str),
 }
 
 _FLAGS: Dict[str, Any] = {}

@@ -10,6 +10,7 @@ IR ops; the executor compiles the whole block into one XLA computation.
 from __future__ import annotations
 
 from ..core import ir
+from ..core import registry as _registry
 from ..core.ir import seqlen_var_name
 from ..layer_helper import LayerHelper
 from .. import initializer as init
@@ -638,7 +639,8 @@ def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
 # adapter — everything else still refuses level-2 input at build time
 # rather than failing cryptically inside jit tracing
 _NESTED_CAPABLE = {"sequence_pool", "sequence_softmax", "sequence_conv",
-                   "sequence_reshape", "sequence_erase", "sequence_slice"}
+                   "sequence_reshape", "sequence_erase", "sequence_slice",
+                   "sequence_expand", "sequence_concat"}
 
 
 def _seq_inputs(helper, x, extra=None):
@@ -730,6 +732,52 @@ def sequence_softmax(input, use_cudnn=False, name=None):
                      outputs={"Out": [out.name]})
     out.lod_level = input.lod_level
     _alias_seqlen(helper, input, out)
+    return out
+
+
+def sequence_concat(input, name=None):
+    """Concatenate sequences row-wise along the time axis (reference
+    sequence_concat_op.cc): row b of the output is
+    concat_i(x_i[b, :len_i[b]]), left-aligned, with length sum_i len_i.
+    Inputs without a lengths companion contribute their full rows.
+    Nested (level-2) inputs concatenate the innermost level per
+    (doc, sentence) row; the outer counts ride through from the first
+    input."""
+    helper = LayerHelper("sequence_concat", name=name)
+    xs = list(input) if isinstance(input, (list, tuple)) else [input]
+    levels = {getattr(x, "lod_level", 0) for x in xs}
+    if len(levels) > 1:
+        # refuse at build time (the module contract above _NESTED_CAPABLE):
+        # the nested op rule flattens every input as [B, S, ...], so a
+        # mixed-level list would die cryptically inside jit tracing
+        raise ValueError(
+            f"sequence_concat: inputs must share one LoD level, got "
+            f"{sorted(levels)} (reference sequence_concat_op.cc requires "
+            f"matching LoD structure)")
+    out = helper.create_variable_for_type_inference(dtype=xs[0].dtype)
+    out.lod_level = max(levels)
+    inputs = {"X": [x.name for x in xs]}
+    seq_names, wired = [], False
+    for x in xs:
+        level = max(getattr(x, "lod_level", 0) - 1, 0)
+        s = helper.ensure_seqlen_var(x, level=level)
+        if s is None:
+            seq_names.append(_registry.EMPTY_VAR)   # full-length rows
+        else:
+            seq_names.append(s.name)
+            wired = True
+    outputs = {"Out": [out.name]}
+    if wired and out.lod_level:
+        inputs["SeqLen"] = seq_names
+        seq_out = helper.ensure_seqlen_var(out, level=out.lod_level - 1)
+        outputs["OutLen"] = [seq_out.name]
+        for lvl in range(out.lod_level - 1):      # nested: outer doc counts
+            src = helper.ensure_seqlen_var(xs[0], level=lvl)
+            if src is not None:
+                dst = helper.ensure_seqlen_var(out, level=lvl)
+                helper.append_op("assign", inputs={"X": [src.name]},
+                                 outputs={"Out": [dst.name]})
+    helper.append_op("sequence_concat", inputs=inputs, outputs=outputs)
     return out
 
 

@@ -39,19 +39,36 @@ NEG_INF = -1e30
 _BLOCK_OVERRIDE = None
 
 
-def _blk(T):
-    """Block sizes (BQ, BK): biggest power-of-two tile <= 1024 dividing
-    T. Tuned by the round-4 chained sweep on v5e (tools/flash_block_sweep
-    .py, docs/PERF.md): 1024x1024 is the reproducible winner at seq
-    2048-4096, causal and not (-18%..-29% vs the round-3 512x512; bigger
-    streamed BK means fewer sequential grid steps to pipeline). Since the
-    kernels stream K/V (resp. Q) through the grid's innermost dimension,
-    VMEM per program is O(blk_q * blk_k + blk * D) regardless of T — no
-    sequence-length cap (validated to seq 32768)."""
+# Per-(seq, causal) tuned tiles, round-5 chained sweep on v5e at D=64
+# (tools/flash_block_sweep.py, docs/PERF.md): wide streamed-K blocks win
+# at these shapes — (512, 2048) is ~10% over 1024^2 at 2048/4096
+# non-causal, and (256, 2048) is ~27% over 1024^2 at 2048 causal (the
+# whole K/V row sits in one block, so the mask is applied in-register
+# instead of paying per-block grid iterations). Shapes not in the table
+# fall back to the biggest power-of-two tile <= 1024 dividing T.
+_BLOCK_TABLE = {
+    (2048, True): (256, 2048),
+    (2048, False): (512, 2048),
+    (4096, False): (512, 2048),
+}
+
+
+def _blk(T, causal=False):
+    """Block sizes (BQ, BK) for sequence length T. Tuned by the chained
+    sweeps on v5e (tools/flash_block_sweep.py, docs/PERF.md): the
+    per-(seq, causal) table above where measured, else the biggest
+    power-of-two tile <= 1024 dividing T (the round-4 reproducible
+    winner; bigger streamed BK means fewer sequential grid steps to
+    pipeline). Since the kernels stream K/V (resp. Q) through the grid's
+    innermost dimension, VMEM per program is O(blk_q * blk_k + blk * D)
+    regardless of T — no sequence-length cap (validated to seq 32768)."""
     if _BLOCK_OVERRIDE is not None:
         bq, bk = _BLOCK_OVERRIDE
         if T % bq == 0 and T % bk == 0:
             return bq, bk
+    tbl = _BLOCK_TABLE.get((int(T), bool(causal)))
+    if tbl is not None and T % tbl[0] == 0 and T % tbl[1] == 0:
+        return tbl
     for b in (1024, 512, 256, 128):
         if T % b == 0:
             return b, b
@@ -316,7 +333,7 @@ def _flash_forward(q, k, v, causal, sm_scale, dropout_rate=0.0, seed=0):
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, T, D = q.shape
-    BQ, BK = _blk(T)
+    BQ, BK = _blk(T, causal)
     q3 = q.reshape(B * H, T, D)
     k3 = k.reshape(B * H, T, D)
     v3 = v.reshape(B * H, T, D)
@@ -363,7 +380,7 @@ def _flash_backward(q, k, v, o, lse, g, causal, sm_scale, dropout_rate, seed):
 
     from jax.experimental.pallas import tpu as pltpu
 
-    BQ, BK = _blk(T)
+    BQ, BK = _blk(T, causal)
     dq_kernel = functools.partial(_flash_dq_kernel, sm_scale=sm_scale,
                                   causal=causal, dropout_rate=dropout_rate)
     dq = pl.pallas_call(

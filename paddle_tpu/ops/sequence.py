@@ -127,9 +127,60 @@ def _sequence_reshape(ctx, X, SeqLen=None):
 
 
 @register_op("sequence_concat", propagate_seqlen=False)
-def _sequence_concat(ctx, X):
+def _sequence_concat(ctx, X, SeqLen=None):
+    """Per-sequence concatenation (reference sequence_concat_op.cc): row b
+    of the output is concat_i(x_i[b, :len_i[b]]), left-aligned in the
+    padded layout, OutLen = sum_i len_i. The old rule concatenated the
+    padded time axes, embedding padding mid-sequence for any ragged row.
+
+    Static-shape realization: concatenate the padded inputs (static
+    offsets P_i), then gather each output position from segment i at
+    P_i + (t - start_i[b]) where start_i[b] = cumsum of valid lengths.
+
+    Nested (level-2) inputs run the same rule on flattened (doc,
+    sentence) rows — innermost-level semantics, reference
+    lod_tensor.h:110."""
     xs = X if isinstance(X, list) else [X]
-    return {"Out": jnp.concatenate(xs, axis=1)}
+    lens = SeqLen if isinstance(SeqLen, list) else \
+        [SeqLen] * (1 if SeqLen is not None else 0)
+    if len(lens) < len(xs):
+        lens = lens + [None] * (len(xs) - len(lens))
+    nested = any(l is not None and l.ndim == 2 for l in lens)
+    if nested:
+        B, S = xs[0].shape[0], xs[0].shape[1]
+        sub = _sequence_concat(
+            ctx, [_flat_rows(x) for x in xs],
+            [None if l is None else l.reshape(-1) for l in lens])
+        return {"Out": _unflat_rows(sub["Out"], B, S),
+                "OutLen": sub["OutLen"].reshape(B, S)}
+    B = xs[0].shape[0]
+    Ts = [int(x.shape[1]) for x in xs]
+    if all(l is None for l in lens):
+        # no lengths anywhere: every row is full, padded concat IS the answer
+        return {"Out": jnp.concatenate(xs, axis=1),
+                "OutLen": jnp.full((B,), sum(Ts), jnp.int32)}
+    L = jnp.stack([jnp.full((B,), t, jnp.int32) if l is None
+                   else l.reshape(B).astype(jnp.int32)
+                   for l, t in zip(lens, Ts)], axis=1)        # [B, N]
+    starts = jnp.concatenate(
+        [jnp.zeros((B, 1), jnp.int32), jnp.cumsum(L, axis=1)], axis=1)
+    xcat = jnp.concatenate(xs, axis=1)                        # [B, sum(Ts), ...]
+    P = [0]
+    for t_i in Ts:
+        P.append(P[-1] + t_i)                                 # static offsets
+    T_out = P[-1]
+    t = jnp.arange(T_out, dtype=jnp.int32)[None, :]           # [1, T_out]
+    src = jnp.zeros((B, T_out), jnp.int32)
+    for i in range(len(xs)):
+        in_seg = (t >= starts[:, i:i + 1]) & (t < starts[:, i + 1:i + 2])
+        src = jnp.where(in_seg, int(P[i]) + t - starts[:, i:i + 1], src)
+    gidx = src.reshape((B, T_out) + (1,) * (xcat.ndim - 2))
+    out = jnp.take_along_axis(
+        xcat, jnp.broadcast_to(gidx, (B, T_out) + xcat.shape[2:]), axis=1)
+    total = starts[:, -1]
+    mask = (t < total[:, None]).reshape((B, T_out) + (1,) * (xcat.ndim - 2))
+    out = jnp.where(mask, out, jnp.zeros((), out.dtype))
+    return {"Out": out, "OutLen": total}
 
 
 @register_op("sequence_slice", propagate_seqlen=False)

@@ -165,16 +165,21 @@ class ParallelExecutor:
         feed_arrays = self._convert_feeds(feed)
 
         from .. import flags as _flags
+        from ..core.executor import resolve_compiler_options
+        copts = resolve_compiler_options(
+            self._mesh.devices.flat[0].platform)
         key = (self._program._uid, self._program._version,
                tuple(sorted(feed_arrays)), tuple(fetch_names),
-               _flags.get_flag("dropout_impl"))
+               _flags.get_flag("dropout_impl"),
+               tuple(sorted(copts.items())) if copts else None)
         self._last_key = key
         compiled = self._cache.get(key)
         if compiled is None:
             compiled = _CompiledProgram(self._program, sorted(feed_arrays),
                                         fetch_names, self._scope, donate=True,
                                         amp=self._build_strategy.amp,
-                                        mesh=self._mesh)
+                                        mesh=self._mesh,
+                                        compiler_options=copts)
             self._cache[key] = compiled
 
         # per-program run counter (see Executor.run): deterministic
@@ -246,6 +251,38 @@ class ParallelExecutor:
         return compiled._step.lower({k: feeds[k] for k in sorted(feeds)},
                                     mut, const, np.uint32(0)).as_text()
 
+    def compiled_text(self, feed) -> str:
+        """Optimized-HLO text of the compiled step — AFTER GSPMD
+        partitioning, so the collectives XLA actually inserted
+        (all-reduce / all-gather / collective-permute / reduce-scatter)
+        are visible and countable. Same contract as lowered_text: run()
+        with this feed first."""
+        if not self._cache:
+            raise RuntimeError("compiled_text requires a prior run()")
+        feeds = self._convert_feeds(feed)
+        names = tuple(sorted(feeds))
+        cands = [k for k in self._cache
+                 if k[2] == names and k[1] == self._program._version]
+        if not cands:
+            raise RuntimeError(
+                f"no compiled step matches feed names {sorted(feeds)}; "
+                f"run() with this feed first")
+        key = self._last_key if self._last_key in cands else cands[-1]
+        compiled = self._cache[key]
+        # memoize: the AOT compile below is a second full GSPMD+XLA
+        # compile of a step run() already compiled (the jit-internal
+        # executable is not publicly reachable); callers probing the
+        # inventory repeatedly must not pay it repeatedly
+        if getattr(compiled, "_hlo_text", None) is not None:
+            return compiled._hlo_text
+        mut = {n: self._scope.find_var(n) for n in compiled.mut_names}
+        const = {n: self._scope.find_var(n) for n in compiled.const_names}
+        compiled._hlo_text = (
+            compiled._step.lower({k: feeds[k] for k in sorted(feeds)},
+                                 mut, const, np.uint32(0))
+            .compile().as_text())
+        return compiled._hlo_text
+
     def _shard_feed(self, arr, var=None):
         # already-global arrays (dist.shard_local_batch on multi-host, or a
         # re-fed fetch) pass through untouched
@@ -279,3 +316,16 @@ class ParallelExecutor:
             spec[1] = "sp"
         return self._place_global(arr, NamedSharding(self._mesh,
                                                      PartitionSpec(*spec)))
+
+
+def collective_inventory(hlo_text: str) -> dict:
+    """Count the collective ops in an optimized-HLO module (one compiled
+    step): which collectives GSPMD actually inserted for a mesh, per
+    step. Async pairs (`-start`/`-done`) count once."""
+    inv = {}
+    for kind in ("all-reduce", "all-gather", "collective-permute",
+                 "reduce-scatter", "all-to-all"):
+        n = hlo_text.count(f" {kind}(") + hlo_text.count(f" {kind}-start(")
+        if n:
+            inv[kind] = n
+    return inv

@@ -62,6 +62,7 @@ class ParameterServer:
         self._dense: Dict[str, np.ndarray] = {}
         self._sparse: Dict[str, _SparseTable] = {}
         self._optim: Dict[str, object] = {}
+        self._opt_cfg: Dict[str, tuple] = {}   # name -> (opt_type, lr, attrs)
         self._locks: Dict[str, threading.Lock] = {}
         self._global_lock = threading.Lock()
         self._barrier = threading.Barrier(trainers) if trainers > 1 else None
@@ -117,6 +118,12 @@ class ParameterServer:
                     msg = rpc.recv_msg(conn)
                 except (ConnectionError, EOFError, OSError):
                     return
+                if self._stop.is_set():
+                    # a stopped server must behave like a dead process:
+                    # drop the request unanswered rather than serving one
+                    # last reply per open connection (crash-recovery tests
+                    # depend on stop() being a hard cut)
+                    return
                 cmd, payload = msg
                 try:
                     reply = self._dispatch(cmd, payload)
@@ -147,6 +154,7 @@ class ParameterServer:
             if name not in self._dense:
                 self._dense[name] = np.array(value, copy=True)
                 self._optim[name] = make_optimizer(opt_type, lr, attrs)
+                self._opt_cfg[name] = (opt_type, float(lr), dict(attrs or {}))
         return ("ok", None)
 
     def _h_get_param(self, name):
@@ -184,6 +192,7 @@ class ParameterServer:
                 self._sparse[name] = _SparseTable(local_rows, width, dtype,
                                                   init_low, init_high, seed)
                 self._optim[name] = make_optimizer(opt_type, lr, attrs)
+                self._opt_cfg[name] = (opt_type, float(lr), dict(attrs or {}))
         return ("ok", None)
 
     def _h_prefetch(self, name, local_ids):
@@ -206,22 +215,76 @@ class ParameterServer:
         return ("ok", None)
 
     # -- checkpoint (reference checkpoint_notify -> save block) ------------
+    def _shard_path(self, dirname):
+        return os.path.join(
+            dirname, f"pserver_{self.endpoint.replace(':', '_')}.npz")
+
     def _h_save(self, dirname):
+        """Snapshot values AND optimizer state (accumulators + config) so
+        a crashed server can be restarted from its shard and training
+        resumes with identical update dynamics (reference checkpoint_notify
+        -> save block on the pserver, request_handler_impl.cc)."""
+        import json
+
         os.makedirs(dirname, exist_ok=True)
         # snapshot each param under its own lock so a checkpoint racing
         # concurrent pushes is internally consistent per-param (the async
         # mode has no global consistent cut — same as the reference)
-        shard = {}
-        for n in list(self._dense):
-            with self._lock(n):
-                shard[n] = self._dense[n].copy()
-        for n in list(self._sparse):
-            with self._lock(n):
-                shard[n] = self._sparse[n].value.copy()
-        path = os.path.join(
-            dirname, f"pserver_{self.endpoint.replace(':', '_')}.npz")
-        np.savez(path, **shard)
+        arrays, meta = {}, {}
+        for kind, names in (("dense", list(self._dense)),
+                            ("sparse", list(self._sparse))):
+            for n in names:
+                with self._lock(n):
+                    val = (self._dense[n] if kind == "dense"
+                           else self._sparse[n].value)
+                    arrays[f"{'d' if kind == 'dense' else 's'}::{n}"] = \
+                        val.copy()
+                    # optimizer state through its own API (one source of
+                    # truth for what constitutes state), arrays flattened
+                    # into the npz
+                    st = self._optim[n].state()
+                    for k, a in st["acc"].items():
+                        arrays[f"o::{n}::{k}"] = np.array(a, copy=True)
+                opt_type, _, _ = self._opt_cfg[n]
+                meta[n] = {"kind": kind, "opt_type": opt_type,
+                           "lr": st["lr"], "attrs": st["attrs"]}
+        path = self._shard_path(dirname)
+        np.savez(path, __meta__=np.array(json.dumps(meta)), **arrays)
         return ("ok", path)
+
+    def recover(self, dirname) -> "ParameterServer":
+        """Restore this server's shard from `dirname` (written by a prior
+        save on the SAME endpoint). Values, sparse tables, and optimizer
+        accumulators all come back, so resumed training continues the
+        exact update sequence — the crash-restart leg of the reference's
+        checkpoint/notify protocol (trainer.py:986 resume path)."""
+        import json
+
+        path = self._shard_path(dirname)
+        with np.load(path, allow_pickle=False) as z:
+            meta = json.loads(str(z["__meta__"]))
+            for name, m in meta.items():
+                with self._lock(name):
+                    if m["kind"] == "dense":
+                        self._dense[name] = z[f"d::{name}"].copy()
+                    else:
+                        tbl = _SparseTable.__new__(_SparseTable)
+                        tbl.value = z[f"s::{name}"].copy()
+                        self._sparse[name] = tbl
+                    opt = make_optimizer(m["opt_type"], m["lr"], m["attrs"])
+                    prefix = f"o::{name}::"
+                    opt.load_state({"lr": m["lr"], "attrs": m["attrs"],
+                                    "acc": {k[len(prefix):]: z[k].copy()
+                                            for k in z.files
+                                            if k.startswith(prefix)}})
+                    self._optim[name] = opt
+                    self._opt_cfg[name] = (m["opt_type"], m["lr"],
+                                           m["attrs"])
+        return self
+
+    def _h_restore(self, dirname):
+        self.recover(dirname)
+        return ("ok", sorted(self._dense) + sorted(self._sparse))
 
     def _h_stats(self):
         return ("ok", {"dense": sorted(self._dense),

@@ -9,7 +9,9 @@ Layout per chunk (all u32 little-endian, matching the reference header
 fields): MAGIC, num_records, checksum (crc32 of the payload), compressor,
 payload_size, then the payload = concatenated [u32 length | bytes]
 records. Compressor 0 = none, 1 = snappy (pure-python codec in
-snappy_codec.py: full decoder, literal-only encoder), 2 = gzip (zlib).
+snappy_codec.py: real greedy-match encoder + framed-stream layer matching
+the reference's snappystream format, header CRC over the compressed bytes
+as chunk.cc places it), 2 = gzip (zlib).
 The byte-level hot path (checksum + record splitting) runs in a small C++
 library (native.cc) compiled lazily with g++; a pure-python fallback keeps
 the format usable without a toolchain."""
@@ -107,13 +109,18 @@ def _split_records(payload: bytes) -> List[bytes]:
 
 def _write_chunk(fo, records: List[bytes], compressor: int):
     payload = b"".join(struct.pack("<I", len(r)) + r for r in records)
-    checksum = _crc32(payload)
     if compressor == GZIP:
+        checksum = _crc32(payload)
         payload = zlib.compress(payload)
     elif compressor == SNAPPY:
+        # reference format: snappystream FRAMED payload, header CRC over
+        # the COMPRESSED bytes (chunk.cc Crc32Stream after compression)
         from . import snappy_codec
-        payload = snappy_codec.compress(payload)
-    elif compressor != NO_COMPRESS:
+        payload = snappy_codec.compress_framed(payload)
+        checksum = _crc32(payload)
+    elif compressor == NO_COMPRESS:
+        checksum = _crc32(payload)
+    else:
         raise ValueError(f"unsupported compressor {compressor}")
     fo.write(_HDR.pack(MAGIC, len(records), checksum, compressor,
                        len(payload)))
@@ -134,13 +141,24 @@ def _read_chunk(fi) -> Optional[List[bytes]]:
         raise IOError("recordio: truncated chunk payload")
     if comp == GZIP:
         payload = zlib.decompress(payload)
+        if _crc32(payload) != checksum:
+            raise IOError("recordio: checksum mismatch")
     elif comp == SNAPPY:
         from . import snappy_codec
-        payload = snappy_codec.decompress(payload)
-    elif comp != NO_COMPRESS:
+        wire = payload
+        payload = (snappy_codec.decompress_framed(wire)
+                   if snappy_codec.is_framed(wire)
+                   else snappy_codec.decompress(wire))
+        # reference placement: CRC over the compressed stream; rounds 3-4
+        # of this repo wrote raw-snappy payloads with CRC over the
+        # DEcompressed bytes — accept either, exact match required
+        if _crc32(wire) != checksum and _crc32(payload) != checksum:
+            raise IOError("recordio: checksum mismatch")
+    elif comp == NO_COMPRESS:
+        if _crc32(payload) != checksum:
+            raise IOError("recordio: checksum mismatch")
+    else:
         raise IOError(f"recordio: unsupported compressor {comp}")
-    if _crc32(payload) != checksum:
-        raise IOError("recordio: checksum mismatch")
     records = _split_records(payload)
     if len(records) != num:
         raise IOError(f"recordio: header claims {num} records, "

@@ -60,10 +60,13 @@ class Float16Transpiler:
                                   attrs={"out_dtype": dtype})
             new_ops.append(cast_op)
 
-        # 2. rewrite consumers to read the casted inputs
-        for op in block.ops:
-            for slot, names in op.inputs.items():
-                op.inputs[slot] = [casted.get(n, n) for n in names]
+        # 2. rewrite consumers to read the casted inputs — in EVERY block:
+        # a sub-block op reading a fed f32 var directly would otherwise
+        # pull the f32 feed into an otherwise-half graph (round-4 advisor)
+        for blk in program.blocks:
+            for op in blk.ops:
+                for slot, names in op.inputs.items():
+                    op.inputs[slot] = [casted.get(n, n) for n in names]
 
         block.ops[:] = new_ops + block.ops
 

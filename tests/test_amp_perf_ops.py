@@ -98,6 +98,31 @@ def test_dropout_deterministic_per_seed_and_varies_per_step():
     np.testing.assert_array_equal(a, a2)  # same seed+step => same mask
 
 
+def test_unseeded_programs_draw_decorrelated_masks():
+    """Two distinct UNSEEDED dropout programs run through one executor
+    must not draw identical key sequences (round-4 advisor: the
+    per-program run counters alone would give both fold_in(key(0), 0..n));
+    the executor folds in its per-program ordinal. Seeded programs keep
+    pure-counter derivation (previous test)."""
+    outs = []
+    exe = fluid.Executor(fluid.CPUPlace())
+    progs = []
+    for _ in range(2):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = layers.data(name="x", shape=[-1, 128], dtype="float32",
+                            append_batch_size=False)
+            y = layers.dropout(x, dropout_prob=0.5,
+                               dropout_implementation="upscale_in_train")
+        progs.append((main, y))
+    xv = np.ones((8, 128), np.float32)
+    for main, y in progs:
+        outs.append(np.asarray(exe.run(main, feed={"x": xv},
+                                       fetch_list=[y])[0]))
+    assert not np.array_equal(outs[0], outs[1]), \
+        "unseeded programs drew identical dropout masks"
+
+
 def test_pallas_dropout_supports_gate():
     from paddle_tpu.ops import pallas_dropout as pd
     import jax.numpy as jnp

@@ -223,6 +223,127 @@ def test_nested_inner_level_erase_and_reshape():
     np.testing.assert_allclose(got_rs[0, 0].reshape(-1), xdat[0, 0].reshape(-1))
 
 
+def test_sequence_concat_ragged_semantics():
+    """Round-5 fix: sequence_concat must compact each row's VALID prefixes
+    (reference sequence_concat_op.cc concatenates per-sequence by LoD) —
+    the old rule concatenated padded time axes, embedding padding
+    mid-sequence for any ragged row."""
+    from paddle_tpu.core.ir import seqlen_var_name
+    a = layers.data(name="ca", shape=[-1, -1, 2], dtype="float32",
+                    lod_level=1, append_batch_size=False)
+    b = layers.data(name="cb", shape=[-1, -1, 2], dtype="float32",
+                    lod_level=1, append_batch_size=False)
+    out = layers.sequence_concat([a, b])
+    assert out.lod_level == 1
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    ad = np.arange(2 * 3 * 2, dtype=np.float32).reshape(2, 3, 2)
+    bd = 100 + np.arange(2 * 2 * 2, dtype=np.float32).reshape(2, 2, 2)
+    alen = np.array([2, 3], np.int32)
+    blen = np.array([1, 2], np.int32)
+    got, glen = exe.run(
+        feed={"ca": (ad, alen), "cb": (bd, blen)},
+        fetch_list=[out, seqlen_var_name(out.name)])
+    got, glen = np.asarray(got), np.asarray(glen)
+    np.testing.assert_array_equal(glen, [3, 5])
+    # row 0: a[0,:2] then b[0,:1], then zeros
+    np.testing.assert_allclose(got[0, :3], np.concatenate(
+        [ad[0, :2], bd[0, :1]], axis=0))
+    np.testing.assert_allclose(got[0, 3:], 0.0)
+    # row 1: a[1,:3] then b[1,:2] — full width
+    np.testing.assert_allclose(got[1], np.concatenate(
+        [ad[1, :3], bd[1, :2]], axis=0))
+
+
+def test_sequence_concat_grad_ignores_padding():
+    """Gradient flows only into valid prefix positions."""
+    a = layers.data(name="ga", shape=[-1, -1, 1], dtype="float32",
+                    lod_level=1, append_batch_size=False)
+    b = layers.data(name="gb", shape=[-1, -1, 1], dtype="float32",
+                    lod_level=1, append_batch_size=False)
+    a.stop_gradient = b.stop_gradient = False
+    out = layers.sequence_concat([a, b])
+    loss = layers.mean(out)
+    fluid.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    ad = np.ones((1, 3, 1), np.float32)
+    bd = np.ones((1, 2, 1), np.float32)
+    ga, gb = exe.run(feed={"ga": (ad, np.array([2], np.int32)),
+                           "gb": (bd, np.array([1], np.int32))},
+                     fetch_list=["ga@GRAD", "gb@GRAD"])
+    ga, gb = np.asarray(ga), np.asarray(gb)
+    assert (ga[0, :2] != 0).all() and (ga[0, 2:] == 0).all()
+    assert (gb[0, :1] != 0).all() and (gb[0, 1:] == 0).all()
+
+
+def test_nested_sequence_concat_semantics():
+    """Level-2 inputs concatenate the INNERMOST level per (doc, sentence)
+    row; outer doc counts ride through."""
+    from paddle_tpu.core.ir import seqlen_var_name
+    prog, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, start), fluid.unique_name.guard():
+        a = layers.data(name="na", shape=[-1, -1, -1, 1], dtype="float32",
+                        lod_level=2, append_batch_size=False)
+        b = layers.data(name="nb", shape=[-1, -1, -1, 1], dtype="float32",
+                        lod_level=2, append_batch_size=False)
+        out = layers.sequence_concat([a, b])
+        assert out.lod_level == 2
+        fetches = [out, seqlen_var_name(out.name, 1),
+                   seqlen_var_name(out.name, 0)]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(start)
+    ad = np.arange(1 * 2 * 3 * 1, dtype=np.float32).reshape(1, 2, 3, 1)
+    bd = 10 + np.arange(1 * 2 * 2 * 1, dtype=np.float32).reshape(1, 2, 2, 1)
+    outer = np.array([2], np.int32)
+    ain = np.array([[2, 3]], np.int32)
+    bin_ = np.array([[2, 1]], np.int32)
+    got, ilen, olen = exe.run(
+        prog, feed={"na": (ad, (outer, ain)), "nb": (bd, (outer, bin_))},
+        fetch_list=fetches)
+    got, ilen, olen = np.asarray(got), np.asarray(ilen), np.asarray(olen)
+    np.testing.assert_array_equal(olen, [2])
+    np.testing.assert_array_equal(ilen, [[4, 4]])
+    # doc0 sent0: a tokens [0,1] then b tokens [10,11]
+    np.testing.assert_allclose(got[0, 0, :4, 0], [0, 1, 10, 11])
+    # doc0 sent1: a tokens [3,4,5] then b token [12]
+    np.testing.assert_allclose(got[0, 1, :4, 0], [3, 4, 5, 12])
+
+
+def test_nested_expand_pipeline_trains():
+    """A level-2 pipeline routed through sequence_expand (per-sentence
+    summary broadcast back over inner tokens) TRAINS — the round-4
+    verdict's acceptance bar for adding expand to _NESTED_CAPABLE."""
+    x = layers.data(name="xe", shape=[2], dtype="float32", lod_level=2)
+    y = layers.data(name="ye", shape=[1], dtype="int64")
+    sent = layers.sequence_pool(x, "average")          # [B, S, 2], lod 1
+    ctxt = layers.sequence_expand(sent, x)             # [B, S, T, 2], lod 2
+    assert ctxt.lod_level == 2
+    mixed = layers.elementwise_mul(x, ctxt)            # token * sent summary
+    tok = layers.sequence_pool(mixed, "sum")           # [B, S, 2]
+    doc = layers.sequence_pool(tok, "average")         # [B, 2]
+    p = layers.fc(input=doc, size=2, act="softmax")
+    loss = layers.mean(layers.cross_entropy(input=p, label=y))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+
+    feeder = fluid.DataFeeder(feed_list=[x, y], place=fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    samples = []
+    for i in range(16):
+        label = i % 2
+        doc_data = [[list(rng.uniform(label, label + 1.0, 2))
+                     for _ in range(rng.randint(2, 5))]
+                    for _ in range(rng.randint(1, 4))]
+        samples.append((doc_data, label))
+    feed = feeder.feed(samples)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = [float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0]))
+              for _ in range(50)]
+    assert losses[-1] < losses[0] * 0.75, losses[::10]
+
+
 def test_create_lod_tensor_nested_list_forms():
     # ragged nested list (the reference's documented form)
     padded, lens = fluid.create_lod_tensor([[1, 2, 3], [4, 5]], [[3, 2]])

@@ -294,7 +294,11 @@ SPECS.update({
                             grad=["X"]),
     "sequence_expand_as": Spec(inputs={"X": T(3, 2), "Y": T(3, 4, 2)},
                                grad=["X"]),
-    "sequence_concat": Spec(inputs={"X": [T(2, 3, 4), T(2, 2, 4)]}),
+    # ragged rows: the old padded-axis concat embedded padding
+    # mid-sequence for exactly this spec shape (round-5 fix)
+    "sequence_concat": Spec(inputs={"X": [T(2, 3, 4), T(2, 2, 4)]},
+                            lod={"X": [np.array([2, 3], np.int32),
+                                       np.array([1, 2], np.int32)]}),
     "sequence_reshape": Spec(inputs={"X": T(2, 4, 6)},
                              attrs={"new_dim": 12}),
     "sequence_conv": Spec(inputs={"X": T(2, 5, 3), "Filter": T(9, 4)},
@@ -612,6 +616,8 @@ def _build_and_run(op_type, spec, amp):
         for k, v in enumerate(vlist):
             name = f"in_{slot}_{k}"
             lod_lens = spec.lod.get(slot)
+            if isinstance(lod_lens, list):   # per-input ragged lengths
+                lod_lens = lod_lens[k]
             block.create_var(name=name, shape=tuple(v.shape),
                             dtype=str(v.dtype), is_data=True,
                             lod_level=1 if lod_lens is not None else 0,
@@ -636,7 +642,10 @@ def _build_and_run(op_type, spec, amp):
     opdef = registry.get_op_def(op_type)
     if "SeqLen" in opdef.input_slots and spec.lod:
         lod_slot = next(iter(spec.lod))
-        op_inputs["SeqLen"] = [seqlen_var_name(input_names[lod_slot][0])]
+        # one companion per wired input — multi-input ops (sequence_concat)
+        # take positionally aligned SeqLen lists
+        op_inputs["SeqLen"] = [seqlen_var_name(n)
+                               for n in input_names[lod_slot]]
     helper.append_op(op_type, inputs=op_inputs,
                      outputs=out_names, attrs=dict(spec.attrs))
 

@@ -92,11 +92,20 @@ def test_mp_parity_dp2_mp4():
     pe, scope, got = _pe_losses(main, startup, loss, batches, m)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
 
-    # an mp-annotated weight is genuinely sharded over 'mp'
-    mp_shards = [n for n in scope.local_var_names()
-                 if hasattr(scope.find_var(n), "sharding")
-                 and "mp" in str(getattr(scope.find_var(n), "sharding", ""))]
-    assert mp_shards, "no scope var is mp-sharded"
+    # an mp-annotated weight is genuinely sharded over 'mp' — inspect the
+    # PartitionSpec tuples, not the repr (a substring match could hit any
+    # var whose repr merely contains "mp"), and pin WHICH axis: ffn1
+    # weights are column-sharded [_, "mp"], ffn2 row-sharded ["mp", _]
+    def _spec(n):
+        v = scope.find_var(n)
+        return tuple(getattr(getattr(v, "sharding", None), "spec", ()) or ())
+
+    ffn1 = [n for n in scope.local_var_names()
+            if "_ffn1" in n and ".w" in n and _spec(n)[-1:] == ("mp",)]
+    ffn2 = [n for n in scope.local_var_names()
+            if "_ffn2" in n and ".w" in n and _spec(n)[:1] == ("mp",)]
+    assert ffn1, "no ffn1 weight is column-sharded over 'mp'"
+    assert ffn2, "no ffn2 weight is row-sharded over 'mp'"
 
 
 def test_mp_sp_parity_dp2_mp2_sp2():

@@ -31,14 +31,27 @@ def parse_flag(argv, name, default):
     return default
 
 
-def slope_step_time(window, steps, lo=None, rounds=3):
+def slope_step_time(window, steps, lo=None, rounds=3, retries=2):
     """Two-point-slope per-step time, median of `rounds`: a window pays
     one ~90 ms tunnel sync regardless of length, so dividing a single
     window by its step count inflates per-step time (~8 ms at 12 steps);
-    the slope is what a steady-state training loop sees."""
+    the slope is what a steady-state training loop sees.
+
+    A tunnel stall landing in the LONG window of 2 of 3 rounds can push
+    the median slope to zero or below; since callers divide by the
+    result, a non-positive median is re-measured and ultimately an error,
+    never a recorded throughput (round-4 advisor)."""
     lo = lo or max(2, steps // 4)
-    slopes = []
-    for _ in range(rounds):
-        t_lo, t_hi = window(lo), window(steps)
-        slopes.append((t_hi - t_lo) / (steps - lo))
-    return sorted(slopes)[len(slopes) // 2]
+    med = None
+    for _ in range(retries + 1):
+        slopes = []
+        for _ in range(rounds):
+            t_lo, t_hi = window(lo), window(steps)
+            slopes.append((t_hi - t_lo) / (steps - lo))
+        med = sorted(slopes)[len(slopes) // 2]
+        if med > 0:
+            return med
+    raise RuntimeError(
+        f"slope_step_time: non-positive median slope {med!r} persisted "
+        f"across {retries + 1} attempts (tunnel stall?) — refusing to "
+        f"record a negative/inf throughput")

@@ -1,0 +1,259 @@
+"""Sweep XLA TPU compiler options on the transformer-base train step.
+
+Round-5 task (VERDICT #1): the hand-written yardstick demonstrates 50.3%
+MFU on this chip while the framework records 46.4–47.5%; the ~3.7 ms
+residue is XLA fusion *grouping*, and every structural (program-level)
+attack measured ~0. This tool attacks the one untried axis: the
+compiler's own knobs, passed per-executable via
+`lowered.compile(compiler_options=...)` — no env mutation, no effect on
+any other compile.
+
+Method (per docs/PERF.md + memory): AOT-compile the SAME lowered step
+once per flag set, then two-point-slope time each executable with donated
+state threaded through, all in one process so tunnel drift cancels in
+the ratios. Baseline is re-measured every few configs; the winner is
+confirmed with a strict interleaved A/B at the end.
+
+Usage:
+    python tools/xla_flag_sweep.py [--model framework|yardstick|both]
+                                   [--steps 15] [--json out.json]
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import sys
+import os
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from tools._common import parse_flag, slope_step_time
+
+# Flag sets to try. Every name here was probe-accepted by this
+# environment's compile server (HTTP 500 on unknown flags, so a typo
+# fails loudly, not silently). Values chosen around the knobs that govern
+# fusion grouping / scheduling on TPU:
+#   - scoped_vmem_limit_kib: VMEM budget the fusion merger may assume;
+#     more lets bigger fusions form (fewer HBM round-trips between them).
+#   - experimental_fusion_cost_model / bundle_aware_cost_model: alternate
+#     profitability models for the same merge decisions.
+#   - multi_level_{input,output}_dot_dot_fusion, dot_dot_fusion_duplicated:
+#     let producer/consumer dots fuse through elementwise chains.
+#   - rwb_fusion: reduce+broadcast grouping (softmax/LN shape).
+#   - vector_{load,store}_fusion_window: instruction-window the vectorizer
+#     scans when folding loads/stores into fusions.
+#   - licm_size_inflation_ratio: loop-invariant code motion threshold.
+#   - aggressive_broadcast_priority_update: scheduler priority tweak.
+SWEEPS = [
+    ("baseline", {}),
+    ("vmem32M", {"xla_tpu_scoped_vmem_limit_kib": "32768"}),
+    ("vmem64M", {"xla_tpu_scoped_vmem_limit_kib": "65536"}),
+    ("vmem96M", {"xla_tpu_scoped_vmem_limit_kib": "98304"}),
+    ("fusion_cost_model",
+     {"xla_tpu_enable_experimental_fusion_cost_model": "true"}),
+    ("bundle_cost_model",
+     {"xla_tpu_use_bundle_aware_cost_model_for_fusions": "true"}),
+    ("dot_dot_ml",
+     {"xla_tpu_enable_multi_level_input_dot_dot_fusion": "true",
+      "xla_tpu_enable_multi_level_output_dot_dot_fusion": "true"}),
+    ("dot_dot_dup", {"xla_tpu_dot_dot_fusion_duplicated": "true"}),
+    ("no_dot_dot", {"xla_tpu_dot_dot_fusion": "false"}),
+    ("no_rwb", {"xla_tpu_rwb_fusion": "false"}),
+    ("no_dot_strength", {"xla_tpu_enable_dot_strength_reduction": "false"}),
+    ("licm2", {"xla_tpu_licm_size_inflation_ratio": "2.0"}),
+    ("bcast_prio",
+     {"xla_tpu_enable_aggressive_broadcast_priority_update": "true"}),
+    ("vload2048", {"xla_tpu_vector_load_fusion_window": "2048"}),
+    ("vstore1024", {"xla_tpu_vector_store_fusion_window": "1024"}),
+    ("lhs", {"xla_tpu_enable_latency_hiding_scheduler": "true"}),
+    ("order_dot_layout", {"xla_tpu_order_dot_after_layout": "true"}),
+]
+
+# Phase 2 (--phase 2): refine around the phase-1 winner
+# (xla_tpu_scoped_vmem_limit_kib=32768, x0.87) and try combos with the
+# runner-ups (bcast_prio x0.94, bundle_cost_model x0.93).
+PHASE2 = [
+    ("baseline", {}),
+    ("vmem24M", {"xla_tpu_scoped_vmem_limit_kib": "24576"}),
+    ("vmem28M", {"xla_tpu_scoped_vmem_limit_kib": "28672"}),
+    ("vmem32M", {"xla_tpu_scoped_vmem_limit_kib": "32768"}),
+    ("vmem40M", {"xla_tpu_scoped_vmem_limit_kib": "40960"}),
+    ("vmem48M", {"xla_tpu_scoped_vmem_limit_kib": "49152"}),
+    ("vmem32M+bcast",
+     {"xla_tpu_scoped_vmem_limit_kib": "32768",
+      "xla_tpu_enable_aggressive_broadcast_priority_update": "true"}),
+    ("vmem32M+bundle",
+     {"xla_tpu_scoped_vmem_limit_kib": "32768",
+      "xla_tpu_use_bundle_aware_cost_model_for_fusions": "true"}),
+    ("vmem32M+no_rwb",
+     {"xla_tpu_scoped_vmem_limit_kib": "32768",
+      "xla_tpu_rwb_fusion": "false"}),
+    ("vmem32M", {"xla_tpu_scoped_vmem_limit_kib": "32768"}),  # repeat: drift check
+]
+
+# Phase 3 (--phase 3): the shipped default vs baseline, interleaved twice —
+# the confirmation A/B (also used on the yardstick for the honest
+# framework-vs-yardstick comparison under identical flags).
+PHASE3 = [
+    ("baseline", {}),
+    ("vmem32M", {"xla_tpu_scoped_vmem_limit_kib": "32768"}),
+    ("baseline", {}),
+    ("vmem32M", {"xla_tpu_scoped_vmem_limit_kib": "32768"}),
+]
+
+
+def build_framework_runner(seq_len=256, batch_size=64, fused=False):
+    """Build the bench transformer program; return (lowered, caller) where
+    caller(compiled) -> window function threading donated state."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    # the executor's own default ("auto") would bake the shipped winner
+    # into jax.jit(compiler_options=...), and jit-level options MERGE into
+    # every per-call lowered.compile(...) — contaminating the baseline.
+    # The sweep must start from compiler defaults.
+    fluid.flags.set_flag("xla_compiler_options", "none")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        feeds, fetches = models.transformer.build(seq_len=seq_len,
+                                                  fused_attention=fused)
+        loss = fetches["loss"]
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0), amp=True)
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    batch = {k: jax.device_put(rng.randint(1, 30000, (batch_size, seq_len))
+                               .astype(np.int32))
+             for k in ("src_word", "trg_word", "lbl_word")}
+    out = exe.run(main, feed=batch, fetch_list=[loss], return_numpy=False,
+                  scope=scope)
+    np.asarray(out[0])
+
+    compiled = max(exe._cache.values(),
+                   key=lambda c: len(c.program.global_block().ops))
+    mut0 = {n: scope.find_var(n) for n in compiled.mut_names}
+    const = {n: scope.find_var(n) for n in compiled.const_names}
+    feeds = {k: batch[k] for k in sorted(batch)}
+    lowered = compiled._step.lower(feeds, mut0, const, np.uint32(0))
+    # ONE state shared across every config: each compiled step donates the
+    # mut buffers it is handed, so the live state must thread through all
+    # configs — re-starting a config from `mut0` would pass deleted arrays
+    state = {"mut": dict(mut0)}
+
+    def make_window(c):
+        def window(n):
+            mut = state["mut"]
+            t0 = time.perf_counter()
+            for _ in range(n):
+                fetches, new_state, _ = c(feeds, mut, const, np.uint32(0))
+                mut = {k: new_state[k] for k in mut}
+            np.asarray(fetches[0])
+            dt = time.perf_counter() - t0
+            state["mut"] = mut
+            return dt
+
+        return window
+
+    return lowered, make_window
+
+
+def build_yardstick_runner(seq_len=256, batch_size=64):
+    import jax
+    from tools import yardstick_transformer as y
+
+    params = y.init_params(0)
+    opt = y.adam_init(params)
+    batch = y.make_batch(batch_size, seq_len)
+    key = jax.random.key(0)
+    lowered = y.train_step.lower(params, opt, batch, key)
+
+    state = {"p": params, "o": opt}      # shared across configs (donation)
+
+    def make_window(c):
+        def window(n):
+            p, o = state["p"], state["o"]
+            t0 = time.perf_counter()
+            for _ in range(n):
+                p, o, loss = c(p, o, batch, key)
+            np.asarray(loss)
+            dt = time.perf_counter() - t0
+            state["p"], state["o"] = p, o
+            return dt
+
+        return window
+
+    return lowered, make_window
+
+
+def time_config(lowered, make_window, options, steps, warmup=3):
+    t0 = time.perf_counter()
+    c = lowered.compile(compiler_options=options) if options \
+        else lowered.compile()
+    compile_s = time.perf_counter() - t0
+    w = make_window(c)
+    w(warmup)
+    dt = slope_step_time(w, steps)
+    del c, w
+    gc.collect()
+    return dt, compile_s
+
+
+def main():
+    argv = sys.argv[1:]
+    model = parse_flag(argv, "--model", "framework")
+    steps = int(parse_flag(argv, "--steps", "15"))
+    out_json = parse_flag(argv, "--json", "")
+    phase = parse_flag(argv, "--phase", "1")
+    sweeps = {"2": PHASE2, "3": PHASE3}.get(phase, SWEEPS)
+    tok = 64 * 256
+
+    targets = []
+    if model in ("framework", "both"):
+        targets.append(("framework", build_framework_runner()))
+    if model in ("yardstick", "both"):
+        targets.append(("yardstick", build_yardstick_runner()))
+
+    results = {}
+    for name, (lowered, make_window) in targets:
+        rows = []
+        base_dt = None
+        for i, (label, opts) in enumerate(sweeps):
+            try:
+                dt, comp_s = time_config(lowered, make_window, opts, steps)
+            except Exception as e:
+                print(f"{name:10s} {label:20s} FAILED: {e!r:.120}",
+                      flush=True)
+                rows.append({"label": label, "opts": opts, "error": str(e)})
+                continue
+            if label == "baseline":
+                base_dt = dt
+            ratio = dt / base_dt if base_dt else float("nan")
+            rows.append({"label": label, "opts": opts, "ms": dt * 1e3,
+                         "vs_baseline": ratio, "compile_s": comp_s})
+            print(f"{name:10s} {label:20s} {dt * 1e3:7.2f} ms/step "
+                  f"({tok / dt / 1e3:6.1f}k tok/s) "
+                  f"x{ratio:.3f} vs base  [compile {comp_s:.0f}s]",
+                  flush=True)
+            # re-anchor the baseline every 6 configs: tunnel drift
+            if i and i % 6 == 0:
+                dt_b, _ = time_config(lowered, make_window, {}, steps)
+                print(f"{name:10s} {'baseline(recheck)':20s} "
+                      f"{dt_b * 1e3:7.2f} ms/step", flush=True)
+                base_dt = dt_b
+        results[name] = rows
+
+    if out_json:
+        with open(out_json, "w") as f:
+            json.dump(results, f, indent=1)
+        print(f"wrote {out_json}")
+
+
+if __name__ == "__main__":
+    main()
