@@ -145,25 +145,46 @@ def _as_feed_array(v, var: Optional[ir.Variable]):
     return arr
 
 
-def resolve_compiler_options(platform: str):
+def resolve_compiler_options(platform: str, program=None):
     """Per-executable XLA options from the `xla_compiler_options` flag.
 
     "auto" applies the measured-good TPU set from the round-5 compiler
     flag sweep (docs/PERF.md): a 32 MiB scoped-VMEM budget lets the
     fusion merger form larger fusions (fewer HBM round-trips between
-    them) — worth ~9% end-to-end on transformer-base, neutral-to-positive
-    on the other benches. Non-TPU backends get None (the names are
-    TPU-only and other backends reject unknown options)."""
+    them) — worth ~9% end-to-end on transformer-base. The same budget
+    measured ~7% SLOWER on ResNet-50 (conv fusions are already at the
+    HBM roofline; the bigger budget regroups them badly), so "auto"
+    applies only to conv-free programs — the boundary the interleaved
+    A/Bs actually support. An explicit k=v list applies unconditionally.
+    Non-TPU backends get None (the names are TPU-only and other backends
+    reject unknown options)."""
     from .. import flags as _flags
 
     val = _flags.get_flag("xla_compiler_options")
     if val == "auto":
-        if platform == "tpu":
-            return {"xla_tpu_scoped_vmem_limit_kib": "32768"}
-        return None
+        if platform != "tpu":
+            return None
+        if program is not None and _program_has_conv(program):
+            return None
+        return {"xla_tpu_scoped_vmem_limit_kib": "32768"}
     if not val or val == "none":
         return None
     return dict(kv.split("=", 1) for kv in val.split(",") if kv)
+
+
+_has_conv_cache: Dict[tuple, bool] = {}
+
+
+def _program_has_conv(program) -> bool:
+    """Memoized per (program uid, version): run() calls this every step
+    and a full op walk on a large program is avoidable repeated work."""
+    key = (program._uid, program._version)
+    hit = _has_conv_cache.get(key)
+    if hit is None:
+        hit = any("conv" in op.type
+                  for block in program.blocks for op in block.ops)
+        _has_conv_cache[key] = hit
+    return hit
 
 
 class _CompiledProgram:
@@ -365,7 +386,8 @@ class Executor:
                 feed_arrays[name] = _as_feed_array(val, var)
 
         from .. import flags as _flags
-        copts = resolve_compiler_options(self.place.jax_device().platform)
+        copts = resolve_compiler_options(self.place.jax_device().platform,
+                                         program)
         cache_key = (program._uid, program._version,
                      tuple(sorted(feed_arrays)), tuple(fetch_names),
                      scope._uid, self.amp, self.check_nan_inf,

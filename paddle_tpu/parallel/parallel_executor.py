@@ -167,7 +167,7 @@ class ParallelExecutor:
         from .. import flags as _flags
         from ..core.executor import resolve_compiler_options
         copts = resolve_compiler_options(
-            self._mesh.devices.flat[0].platform)
+            self._mesh.devices.flat[0].platform, self._program)
         key = (self._program._uid, self._program._version,
                tuple(sorted(feed_arrays)), tuple(fetch_names),
                _flags.get_flag("dropout_impl"),

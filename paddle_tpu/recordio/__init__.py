@@ -12,9 +12,10 @@ records. Compressor 0 = none, 1 = snappy (pure-python codec in
 snappy_codec.py: real greedy-match encoder + framed-stream layer matching
 the reference's snappystream format, header CRC over the compressed bytes
 as chunk.cc places it), 2 = gzip (zlib).
-The byte-level hot path (checksum + record splitting) runs in a small C++
-library (native.cc) compiled lazily with g++; a pure-python fallback keeps
-the format usable without a toolchain."""
+The byte-level hot paths (checksums, record splitting, and the snappy
+match/replay loops) run in a small C++ library (native.cc) compiled
+lazily with g++; pure-python fallbacks keep the format usable without a
+toolchain."""
 
 from __future__ import annotations
 
@@ -64,6 +65,13 @@ def _load_native():
             ctypes.c_char_p, ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_uint32),
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t]
+        if hasattr(lib, "rio_snappy_compress"):  # round-5 additions
+            lib.rio_crc32c.restype = ctypes.c_uint32
+            lib.rio_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+            for fn in (lib.rio_snappy_compress, lib.rio_snappy_decompress):
+                fn.restype = ctypes.c_long
+                fn.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                               ctypes.c_char_p, ctypes.c_size_t]
         _native = lib
     except Exception as e:  # no g++ / sandbox: python fallback
         logger.info("recordio: native library unavailable (%s); using "

@@ -23,11 +23,23 @@ directly from the public format specs:
 
 from __future__ import annotations
 
+import ctypes
 import struct
 
 
 class SnappyError(IOError):
     pass
+
+
+def _native():
+    """The librecordio.so hot path (native.cc), if buildable: the greedy
+    matcher and copy-replay are per-byte loops that belong in C++ — the
+    pure-python paths below stay as the no-toolchain fallback and as the
+    executable spec the tests cross-check against."""
+    from . import _load_native
+
+    lib = _load_native()
+    return lib if lib and hasattr(lib, "rio_snappy_compress") else None
 
 
 def _read_varint32(buf: bytes, pos: int):
@@ -59,7 +71,21 @@ def _write_varint32(n: int) -> bytes:
 
 
 def decompress(buf: bytes) -> bytes:
-    """Full snappy raw-format decoder."""
+    """Full snappy raw-format decoder (native hot path when available)."""
+    lib = _native()
+    if lib is not None:
+        expected, _ = _read_varint32(buf, 0)
+        out = ctypes.create_string_buffer(max(expected, 1))
+        m = lib.rio_snappy_decompress(bytes(buf), len(buf), out, expected)
+        if m >= 0:
+            return out.raw[:m]
+        raise SnappyError("snappy: malformed stream"
+                          if m == -1 else "snappy: length mismatch")
+    return _decompress_py(buf)
+
+
+def _decompress_py(buf: bytes) -> bytes:
+    """Pure-python decoder — the executable spec and no-g++ fallback."""
     expected, pos = _read_varint32(buf, 0)
     out = bytearray()
     n = len(buf)
@@ -151,10 +177,23 @@ _HASH_MUL = 0x1E35A7BD                                 # C snappy's multiplier
 
 
 def compress(buf: bytes) -> bytes:
-    """Raw-snappy encoder with greedy hash-table matching (the C
-    library's scheme): 4-byte prefixes hash into a table of recent
+    """Raw-snappy encoder with greedy hash-table matching (native hot
+    path when available): 4-byte prefixes hash into a table of recent
     positions; a >=4-byte match within the 64 KB offset window becomes a
     copy element, everything between matches a literal."""
+    lib = _native()
+    if lib is not None:
+        n = len(buf)
+        cap = 16 + n + 3 * (n // 65536 + 1)
+        out = ctypes.create_string_buffer(cap)
+        m = lib.rio_snappy_compress(bytes(buf), n, out, cap)
+        if m > 0:
+            return out.raw[:m]
+    return _compress_py(buf)
+
+
+def _compress_py(buf: bytes) -> bytes:
+    """Pure-python encoder — the executable spec and no-g++ fallback."""
     n = len(buf)
     out = bytearray(_write_varint32(n))
     if n < 4:
@@ -195,6 +234,13 @@ _MAX_FRAME = 65536                                     # uncompressed bytes
 
 def _crc32c(data: bytes) -> int:
     """CRC-32C (Castagnoli), the checksum the framing format mandates."""
+    lib = _native()
+    if lib is not None:
+        return lib.rio_crc32c(bytes(data), len(data))
+    return _crc32c_py(data)
+
+
+def _crc32c_py(data: bytes) -> int:
     tab = _crc32c_table()
     crc = 0xFFFFFFFF
     for b in data:

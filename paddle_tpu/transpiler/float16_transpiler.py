@@ -40,9 +40,16 @@ class Float16Transpiler:
         # 1. fed data vars keep their f32 dtype; a boundary cast feeds the
         # half-precision graph (reference inserts the same casts). Only
         # vars some op actually READS get a cast — an unconditional cast
-        # would turn ignorable leftover data vars into mandatory feeds
+        # would turn ignorable leftover data vars into mandatory feeds.
+        # Reads INSIDE control-flow sub-blocks count too: a data var
+        # consumed only by a while/cond body would otherwise get no cast
+        # and pull its raw f32 feed into the half graph (round-4/5
+        # advisor) — same scan the Executor does for its read set.
         read_names = {n for op in block.ops
                       for names in op.inputs.values() for n in names}
+        for op in block.ops:
+            for si in ir.sub_block_indices(op):
+                read_names.update(ir.external_reads(program, si))
         casted = {}
         new_ops = []
         consumed_data = [v for v in block.vars.values()

@@ -215,3 +215,39 @@ def test_float16_transpiler_inference_parity(tmp_path):
     np.testing.assert_allclose(got, ref, atol=2e-2)
     # ranking preserved (the inference quantity that matters)
     np.testing.assert_array_equal(got.argmax(1), ref.argmax(1))
+
+
+def test_float16_transpiler_casts_subblock_only_reads():
+    """A fed f32 var consumed ONLY inside a control-flow sub-block must
+    still get its boundary cast (round-5 advisor: the read scan used to
+    walk only the global block, leaving the sub-block reading a raw f32
+    feed into a half graph)."""
+    import jax.numpy as jnp
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="xf", shape=[4], dtype="float32")
+        flag = layers.fill_constant(shape=[1], dtype="bool", value=True)
+        ie = layers.IfElse(flag)
+        with ie.true_block():
+            # x is read ONLY here, inside the sub-block
+            ie.output(layers.fc(input=x, size=3, act=None))
+        with ie.false_block():
+            ie.output(layers.fill_constant(shape=[1, 3], dtype="float32",
+                                           value=0.0))
+        out = ie()[0]
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    xv = np.random.RandomState(1).randn(2, 4).astype(np.float32)
+    ref = np.asarray(exe.run(main, feed={"xf": xv}, fetch_list=[out],
+                             scope=scope)[0])
+
+    t = fluid.transpiler.Float16Transpiler()
+    t.transpile(main, scope=scope, dtype="bfloat16")
+    casted = [v for v in main.global_block().vars.values()
+              if v.name.endswith(".cast_fp16")]
+    assert casted, "sub-block-only read got no boundary cast"
+    got = np.asarray(exe.run(main, feed={"xf": xv}, fetch_list=[out],
+                             scope=scope)[0]).astype(np.float32)
+    np.testing.assert_allclose(got, ref, atol=2e-2)

@@ -105,6 +105,27 @@ PHASE3 = [
     ("vmem32M", {"xla_tpu_scoped_vmem_limit_kib": "32768"}),
 ]
 
+_V32 = {"xla_tpu_scoped_vmem_limit_kib": "32768"}
+# Phase 4 (--phase 4): the remaining phase-1 mild winners stacked ON TOP
+# of the shipped vmem32M, plus a finer vmem grid around 32 MiB — chasing
+# the last ~4.5% to the yardstick's best build.
+PHASE4 = [
+    ("baseline", {}),
+    ("vmem32M", dict(_V32)),
+    ("vmem30M", {"xla_tpu_scoped_vmem_limit_kib": "30720"}),
+    ("vmem34M", {"xla_tpu_scoped_vmem_limit_kib": "34816"}),
+    ("v32+vstore1024", {**_V32, "xla_tpu_vector_store_fusion_window": "1024"}),
+    ("v32+order_dot", {**_V32, "xla_tpu_order_dot_after_layout": "true"}),
+    ("v32+fusion_cost", {**_V32,
+                         "xla_tpu_enable_experimental_fusion_cost_model": "true"}),
+    ("v32+dot_dot_ml", {**_V32,
+                        "xla_tpu_enable_multi_level_input_dot_dot_fusion": "true",
+                        "xla_tpu_enable_multi_level_output_dot_dot_fusion": "true"}),
+    ("v32+no_dot_strength", {**_V32,
+                             "xla_tpu_enable_dot_strength_reduction": "false"}),
+    ("vmem32M", dict(_V32)),   # repeat: drift check
+]
+
 
 def build_framework_runner(seq_len=256, batch_size=64, fused=False):
     """Build the bench transformer program; return (lowered, caller) where
@@ -211,7 +232,7 @@ def main():
     steps = int(parse_flag(argv, "--steps", "15"))
     out_json = parse_flag(argv, "--json", "")
     phase = parse_flag(argv, "--phase", "1")
-    sweeps = {"2": PHASE2, "3": PHASE3}.get(phase, SWEEPS)
+    sweeps = {"2": PHASE2, "3": PHASE3, "4": PHASE4}.get(phase, SWEEPS)
     tok = 64 * 256
 
     targets = []
