@@ -7,4 +7,4 @@ Sync modes never come here: they collapse to GSPMD collectives
 
 from .server import ParameterServer  # noqa: F401
 from .client import PSClient  # noqa: F401
-from .trainer import AsyncPSTrainer  # noqa: F401
+from .trainer import AsyncPSTrainer, SyncPSTrainer  # noqa: F401

@@ -165,6 +165,29 @@ class PSClient:
                        local_ids=ids[mask] // n,
                        row_grads=np.asarray(row_grads)[mask])
 
+    # -- sync mode (reference RunSyncLoop) ----------------------------------
+    def push_grads_sync(self, by_ep: Dict[str, Dict[str, np.ndarray]]):
+        """Batched per-endpoint sends whose updates are DEFERRED to the
+        sync_apply barrier (reference kRequestSend accumulation)."""
+        if len(by_ep) <= 1:
+            for ep, grads in by_ep.items():
+                self._call(ep, "push_grads_sync", grads=grads)
+            return
+        futs = [self._pool.submit(self._call, ep, "push_grads_sync",
+                                  grads=grads)
+                for ep, grads in by_ep.items()]
+        for f in futs:
+            f.result()
+
+    def sync_apply(self, endpoints: Sequence[str]):
+        """Per-batch barrier on every server: blocks until ALL trainers
+        have pushed and the aggregated update is applied (reference
+        batch-barrier + optimize blocks, then kRequestGet unblocks)."""
+        futs = [self._pool.submit(self._call, ep, "sync_apply")
+                for ep in endpoints]
+        for f in futs:
+            f.result()
+
     # -- control ------------------------------------------------------------
     def barrier(self):
         for ep in self.endpoints:

@@ -63,6 +63,13 @@ class ParameterServer:
         self._sparse: Dict[str, _SparseTable] = {}
         self._optim: Dict[str, object] = {}
         self._opt_cfg: Dict[str, tuple] = {}   # name -> (opt_type, lr, attrs)
+        # sync mode (reference RunSyncLoop, listen_and_serv_op.cc:106):
+        # per-batch gradient accumulation + a barrier whose action applies
+        # the aggregated update ONCE before any trainer proceeds
+        self._pending: Dict[str, np.ndarray] = {}
+        self._pending_lock = threading.Lock()
+        self._sync_barrier = threading.Barrier(trainers,
+                                               action=self._apply_pending)
         self._locks: Dict[str, threading.Lock] = {}
         self._global_lock = threading.Lock()
         self._barrier = threading.Barrier(trainers) if trainers > 1 else None
@@ -212,6 +219,52 @@ class ParameterServer:
     def _h_batch_barrier(self):
         if self._barrier is not None:
             self._barrier.wait()
+        return ("ok", None)
+
+    # -- sync mode: per-batch accumulate + barrier-apply -------------------
+    # (reference RunSyncLoop, listen_and_serv_op.cc:106: kRequestSend from
+    # every trainer, then the optimize blocks run once on the aggregated
+    # gradients, then kRequestGet unblocks)
+    def _h_push_grads_sync(self, grads):
+        """Accumulate this trainer's gradients for the CURRENT batch; the
+        update is applied at the sync_apply barrier, not here."""
+        with self._pending_lock:
+            for n, g in grads.items():
+                g = np.asarray(g)
+                self._pending[n] = (g if n not in self._pending
+                                    else self._pending[n] + g)
+        return ("ok", None)
+
+    def _apply_pending(self):
+        """Barrier action: runs exactly once per batch, in one of the
+        waiting connection threads, before any trainer is released. The
+        aggregated gradient is AVERAGED over trainers (each trainer's
+        grad is the mean over its local shard, so the applied update
+        equals single-process training on the combined batch — the
+        ParallelExecutor CoeffNumDevice convention)."""
+        with self._pending_lock:
+            pending, self._pending = self._pending, {}
+        for n, g in pending.items():
+            with self._lock(n):
+                self._optim[n].dense(self._dense[n], g / self.trainers)
+
+    def _h_sync_apply(self):
+        try:
+            self._sync_barrier.wait(timeout=120)
+        except threading.BrokenBarrierError:
+            # recover rather than poison the long-lived server: discard
+            # the incomplete batch's accumulated gradients (a retry must
+            # start clean, never double-apply) and reset the barrier so a
+            # retrying or restarted trainer can proceed. The `broken`
+            # check keeps a second recovering thread from resetting a
+            # barrier fresh waiters have already entered.
+            with self._pending_lock:
+                self._pending.clear()
+                if self._sync_barrier.broken:
+                    self._sync_barrier.reset()
+            return ("err", "sync barrier broken (a trainer died or timed "
+                           "out mid-batch); batch discarded, barrier "
+                           "reset — retry the step")
         return ("ok", None)
 
     # -- checkpoint (reference checkpoint_notify -> save block) ------------

@@ -115,16 +115,36 @@ class AsyncPSTrainer:
                 opt_type=spec["opt_type"], lr=self._lr_of(spec),
                 attrs=spec["attrs"])
 
-    # -- one async step ---------------------------------------------------
-    def step(self, feed: Dict, fetch_list: Sequence) -> List[np.ndarray]:
-        # 1. recv: freshest dense params — ONE batched RPC per endpoint, in
-        # parallel (reference overlaps AsyncGetVar handles the same way)
+    def _scope_kw(self) -> Dict:
+        """The jitted step must run against the trainer's scope when one
+        was given explicitly; duck-typed executor adapters (e.g. a
+        ParallelExecutor wrapper, which owns its scope) may not accept a
+        scope kwarg, so the global-scope default passes nothing."""
+        if self.scope is core_exec.global_scope():
+            return {}
+        return {"scope": self.scope}
+
+    def _recv_dense(self):
+        """Pull the dense params into the scope — ONE batched RPC per
+        endpoint, in parallel (reference overlaps AsyncGetVar handles the
+        same way)."""
         by_ep: Dict[str, List[str]] = {}
         for pname, spec in self.t.param_specs.items():
             by_ep.setdefault(spec["endpoint"], []).append(pname)
         for ep, values in self.client.get_params_parallel(by_ep).items():
             for pname, value in values.items():
                 self.scope.set_var(pname, value)
+
+    def _dense_grads_by_ep(self, grads) -> Dict[str, Dict[str, np.ndarray]]:
+        by_ep: Dict[str, Dict[str, np.ndarray]] = {}
+        for (pname, spec), g in zip(self.t.param_specs.items(), grads):
+            by_ep.setdefault(spec["endpoint"], {})[pname] = g
+        return by_ep
+
+    # -- one async step ---------------------------------------------------
+    def step(self, feed: Dict, fetch_list: Sequence) -> List[np.ndarray]:
+        # 1. recv the freshest dense params
+        self._recv_dense()
 
         # 2. prefetch: per table GROUP (tables sharing an ids feed share one
         # uniq/remap — the fed ids var can only hold one mapping)
@@ -162,15 +182,13 @@ class AsyncPSTrainer:
         grad_fetches = [self.t.grad_names[p] for p in self.t.param_specs]
         grad_fetches += [self.t.grad_names[w] for w, _ in pushes]
         outs = self.exe.run(self.program, feed=feed,
-                            fetch_list=list(fetch_list) + grad_fetches)
+                            fetch_list=list(fetch_list) + grad_fetches,
+                            **self._scope_kw())
         user_outs = outs[: len(fetch_list)]
         grads = outs[len(fetch_list):]
 
         # 4. send: barrierless pushes, batched per endpoint
-        dense_by_ep: Dict[str, Dict[str, np.ndarray]] = {}
-        for (pname, spec), g in zip(self.t.param_specs.items(), grads):
-            dense_by_ep.setdefault(spec["endpoint"], {})[pname] = g
-        self.client.push_grads_parallel(dense_by_ep)
+        self.client.push_grads_parallel(self._dense_grads_by_ep(grads))
         for (wname, uniq), g in zip(pushes,
                                     grads[len(self.t.param_specs):]):
             self.client.push_sparse_grad(wname, uniq, g[: uniq.shape[0]])
@@ -182,3 +200,50 @@ class AsyncPSTrainer:
 
     def close(self):
         self.client.close()
+
+
+class SyncPSTrainer(AsyncPSTrainer):
+    """Sync-mode parameter-server training — the process-based analog of
+    the reference's RunSyncLoop (listen_and_serv_op.cc:106): every batch,
+    all trainers send their gradients, a per-batch barrier fires the
+    aggregated update ONCE server-side, and only then does any trainer
+    proceed (its next pull reads the post-update params — the reference's
+    kRequestGet-after-optimize ordering).
+
+    Dense parameters only: distributed lookup tables are inherently
+    barrierless on the host path (use async or hybrid mode — reference
+    deployments run sparse CTR async for the same reason). On TPU the
+    RECOMMENDED sync data-parallel path remains GSPMD collectives
+    (DistributeTranspiler default); this runtime exists for reference
+    execution-mode parity and for host-only deployments.
+    """
+
+    def __init__(self, transpiler, exe, program=None, scope=None):
+        super().__init__(transpiler, exe, program=program, scope=scope)
+        if transpiler.sparse_specs:
+            raise NotImplementedError(
+                "sync PS mode is dense-only: distributed lookup tables "
+                "update barrierlessly (reference runs sparse CTR async); "
+                "use sync_mode=False or mode='hybrid'")
+
+    def step(self, feed: Dict, fetch_list: Sequence) -> List[np.ndarray]:
+        # 1. recv: params as of the LAST barrier (identical on every
+        # trainer — the barrier ordered the previous batch's update
+        # before any release)
+        self._recv_dense()
+
+        # 2. the jitted step
+        grad_fetches = [self.t.grad_names[p] for p in self.t.param_specs]
+        outs = self.exe.run(self.program, feed=feed,
+                            fetch_list=list(fetch_list) + grad_fetches,
+                            **self._scope_kw())
+        user_outs = outs[: len(fetch_list)]
+        grads = outs[len(fetch_list):]
+
+        # 3. send: accumulate-only pushes ...
+        self.client.push_grads_sync(self._dense_grads_by_ep(grads))
+
+        # 4. ... then the per-batch barrier on EVERY server (each counts
+        # all trainers); returning means the aggregated update is applied
+        self.client.sync_apply(self.t._pserver_endpoints)
+        return user_outs

@@ -13,7 +13,11 @@ over a (possibly multi-host) device mesh:
 
   - sync pserver mode / nccl2 mode  -> data parallelism over the 'dp' axis;
     gradient aggregation is an XLA all-reduce over ICI/DCN (the transpiled
-    program is UNCHANGED — the mesh + shardings do the work).
+    program is UNCHANGED — the mesh + shardings do the work). A
+    process-based sync-PS runtime also exists for reference
+    execution-mode parity (config.runtime='pserver': per-batch barriers +
+    aggregated server-side updates, the RunSyncLoop analog driven by
+    pserver.SyncPSTrainer).
   - sliced params on pservers       -> ZeRO-style optimizer-state sharding
     (BuildStrategy.ReduceStrategy.Reduce), XLA emits reduce-scatter.
   - distributed lookup table (P5)   -> large embedding tables sharded over
@@ -51,6 +55,11 @@ class DistributeTranspilerConfig:
     min_block_size = 8192
     split_method = RoundRobin
     mode = "nccl2"  # every sync mode collapses to collectives on TPU
+    # sync-mode runtime: "collective" (default — GSPMD all-reduce over the
+    # mesh, the TPU-native path) or "pserver" (process-based sync PS with
+    # per-batch barriers — the reference RunSyncLoop analog, driven by
+    # pserver.SyncPSTrainer; dense params only)
+    runtime = "collective"
     # TPU extension: shard embedding tables with >= this many rows
     distributed_lookup_threshold = 100_000
     # static row budget for the per-batch prefetched sub-table (the XLA step
@@ -66,6 +75,7 @@ class DistributeTranspiler:
         self._trainers = 1
         self._program: Optional[ir.Program] = None
         self.sync_mode = True
+        self._sync_ps = False
         # async-mode plan, consumed by pserver.AsyncPSTrainer and
         # get_pserver_program
         self.param_specs: Dict[str, dict] = {}   # dense: name -> spec
@@ -86,10 +96,21 @@ class DistributeTranspiler:
         self._pserver_endpoints = [e for e in pservers.split(",") if e]
         self._hybrid = mode == "hybrid"
         self.sync_mode = sync_mode and not self._hybrid
+        # process-based sync PS (reference RunSyncLoop): same stripped
+        # trainer program and per-param server specs as async — only the
+        # trainer driver (SyncPSTrainer: accumulate + barrier-apply)
+        # differs
+        self._sync_ps = (self.sync_mode
+                         and self.config.runtime == "pserver")
         if self._hybrid:
             if not self._pserver_endpoints:
                 raise ValueError("hybrid mode needs pservers='host:port,...'")
             self._build_async_plan(dense_local=True)
+        elif self._sync_ps:
+            if not self._pserver_endpoints:
+                raise ValueError(
+                    "sync pserver runtime needs pservers='host:port,...'")
+            self._build_async_plan()
         elif sync_mode:
             self._annotate_distributed_tables()
         else:
@@ -195,15 +216,22 @@ class DistributeTranspiler:
         return self._program
 
     def get_pserver_program(self, endpoint) -> ir.Program:
-        """Async mode: a program holding one `listen_and_serv` op (reference
-        listen_and_serv_op.cc); `Executor.run` on it blocks serving. Sync
-        mode has no pserver processes on TPU (GSPMD owns the exchange)."""
-        if self.sync_mode:
+        """A program holding one `listen_and_serv` op (reference
+        listen_and_serv_op.cc); `Executor.run` on it blocks serving.
+        Available in async mode, hybrid mode, and — since round 5 — the
+        sync "pserver" runtime (RunSyncLoop analog: per-batch barriers,
+        aggregated server-side updates). The sync DEFAULT on TPU remains
+        the collective runtime: parameters live sharded/replicated in
+        chip HBM and updates run inside the compiled step (GSPMD
+        all-reduce) — set DistributeTranspilerConfig.runtime='pserver'
+        for the process-based mode."""
+        if self.sync_mode and not self._sync_ps:
             raise NotImplementedError(
-                "sync mode on TPU has no parameter-server processes: "
-                "parameters live sharded/replicated in chip HBM and updates "
-                "run inside the compiled step (GSPMD all-reduce). Use "
-                "sync_mode=False for the host pserver runtime")
+                "sync mode with runtime='collective' has no parameter-"
+                "server processes: GSPMD owns the exchange. Set "
+                "DistributeTranspilerConfig.runtime='pserver' for the "
+                "process-based sync runtime (RunSyncLoop analog), or "
+                "sync_mode=False for async")
         prog = ir.Program()
         # the server is generic: params/tables arrive via init_param /
         # init_table RPCs from the trainers (first writer wins), so the op

@@ -196,9 +196,10 @@ def test_hybrid_collective_dense_ps_sparse():
 
         class _PEAdapter:
             """AsyncPSTrainer drives exe.run(program, feed, fetch_list);
-            route it through the collective executor."""
+            route it through the collective executor (which owns the same
+            scope the trainer was handed, so the scope kwarg is absorbed)."""
 
-            def run(self, program, feed, fetch_list):
+            def run(self, program, feed, fetch_list, scope=None):
                 names = [f.name if hasattr(f, "name") else str(f)
                          for f in fetch_list]
                 return pe.run(feed=feed, fetch_list=names)

@@ -140,6 +140,155 @@ def test_async_ps_trainer_fc_model(two_servers):
     tr.close()
 
 
+def _build_sync_net(seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="int64")
+        h = layers.fc(input=x, size=16, act="relu")
+        logits = layers.fc(input=h, size=2, act=None)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGD(learning_rate=0.2).minimize(loss)
+    main.random_seed = startup.random_seed = seed
+    return main, startup, loss
+
+
+def test_sync_ps_two_trainers_match_single_process():
+    """Process-based SYNC parameter servers (reference RunSyncLoop,
+    listen_and_serv_op.cc:106 — the one reference execution mode with no
+    analog until round 5): two trainers each compute gradients on half
+    the batch, all sends hit a per-batch barrier, the server applies the
+    AGGREGATED update once, and only then does any trainer proceed. With
+    SGD this must EQUAL single-process training on the full batch."""
+    import threading
+
+    from paddle_tpu.pserver import SyncPSTrainer
+
+    STEPS = 5
+    rng = np.random.RandomState(5)
+    w_true = rng.randn(8, 2).astype(np.float32)
+    xs = rng.randn(STEPS, 32, 8).astype(np.float32)
+    ys = (xs @ w_true).argmax(-1).astype(np.int64)[..., None]
+
+    # single-process reference on the full batch
+    main_r, startup_r, loss_r = _build_sync_net()
+    scope_r = fluid.Scope()
+    exe_r = fluid.Executor(fluid.CPUPlace())
+    exe_r.run(startup_r, scope=scope_r)
+    ref_losses = []
+    for s in range(STEPS):
+        l, = exe_r.run(main_r, feed={"x": xs[s], "y": ys[s]},
+                       fetch_list=[loss_r], scope=scope_r)
+        ref_losses.append(float(np.asarray(l).reshape(-1)[0]))
+
+    servers = [ParameterServer("127.0.0.1:0", trainers=2).start()
+               for _ in range(2)]
+    eps = ",".join(s.endpoint for s in servers)
+    results = {}
+
+    # builds are SEQUENTIAL (program construction shares the global
+    # unique-name state — a concurrent build interleaves names); only the
+    # lockstep training loops run concurrently, which the sync barrier
+    # requires
+    trainers = []
+    for tid in range(2):
+        main, startup, loss = _build_sync_net()
+        cfg = fluid.DistributeTranspilerConfig()
+        cfg.runtime = "pserver"
+        t = fluid.DistributeTranspiler(cfg)
+        t.transpile(trainer_id=tid, program=main, pservers=eps,
+                    trainers=2, sync_mode=True)
+        assert t._sync_ps and t.param_specs
+        assert not any(op.type == "sgd" for op in main.global_block().ops)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        tr = SyncPSTrainer(t, exe, scope=scope)
+        tr.init_params()           # identical seeded init; first writer wins
+        # pre-compile the step once per trainer OUTSIDE the barrier loop:
+        # the same (feed names, fetch names) signature tr.step will use,
+        # run directly (no optimizer ops in the stripped program, so this
+        # is pure compute). Without it, two concurrent first-compiles on
+        # a contended 1-core host can outlast the 120 s sync barrier.
+        grad_fetches = [t.grad_names[p] for p in t.param_specs]
+        exe.run(main, feed={"x": xs[0, :16], "y": ys[0, :16]},
+                fetch_list=[loss] + grad_fetches, scope=scope)
+        trainers.append((tid, t, tr, loss))
+
+    def trainer_loop(tid, t, tr, loss):
+        try:
+            lo, hi = (0, 16) if tid == 0 else (16, 32)
+            losses = []
+            for s in range(STEPS):
+                l, = tr.step({"x": xs[s, lo:hi], "y": ys[s, lo:hi]},
+                             fetch_list=[loss])
+                losses.append(float(np.asarray(l).reshape(-1)[0]))
+            results[tid] = (losses, {
+                p: tr.client.get_param(spec["endpoint"], p)
+                for p, spec in t.param_specs.items()})
+            tr.close()
+        except BaseException as e:   # surface thread failures to the test
+            results[tid] = e
+            raise
+
+    try:
+        threads = [threading.Thread(target=trainer_loop, args=args)
+                   for args in trainers]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=300)
+        for tid in range(2):
+            assert tid in results, f"trainer {tid} never finished"
+            assert not isinstance(results[tid], BaseException), results[tid]
+
+        # per-step losses: mean of the two trainers' half-batch losses ==
+        # the single-process full-batch loss (same params each step, by
+        # the barrier ordering)
+        l0, l1 = results[0][0], results[1][0]
+        np.testing.assert_allclose([(a + b) / 2 for a, b in zip(l0, l1)],
+                                   ref_losses, rtol=1e-4, atol=1e-5)
+        # final server-side params == single-process params
+        for pname, got in results[0][1].items():
+            np.testing.assert_allclose(
+                got, np.asarray(scope_r.find_var(pname)), rtol=1e-4,
+                atol=1e-5, err_msg=pname)
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_sync_ps_refuses_sparse_and_collective_runtime_has_no_pserver():
+    """Contract edges: SyncPSTrainer is dense-only, and the default
+    collective runtime still refuses get_pserver_program in sync mode."""
+    from paddle_tpu.pserver import SyncPSTrainer
+
+    t = fluid.DistributeTranspiler()
+    main, startup, loss = _build_sync_net()
+    t.transpile(trainer_id=0, program=main, pservers="127.0.0.1:6174",
+                trainers=1, sync_mode=True)
+    with pytest.raises(NotImplementedError, match="runtime='pserver'"):
+        t.get_pserver_program("127.0.0.1:6174")
+
+    # a distributed lookup table in the sync pserver runtime must be
+    # refused loudly — sparse updates are barrierless by design
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2), fluid.unique_name.guard():
+        ids = layers.data(name="sids", shape=[2], dtype="int64")
+        emb = layers.embedding(ids, size=[50, 4], is_distributed=True)
+        loss2 = layers.mean(emb)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss2)
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.runtime = "pserver"
+    t2 = fluid.DistributeTranspiler(cfg)
+    t2.transpile(trainer_id=0, program=main2, pservers="127.0.0.1:6174",
+                 trainers=1, sync_mode=True)
+    assert t2.sparse_specs
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(NotImplementedError, match="dense-only"):
+        SyncPSTrainer(t2, exe)
+
+
 def test_pserver_crash_restart_resumes_training(tmp_path):
     """Kill one pserver mid-async-DeepFM, restart it on the same endpoint
     from its shard snapshot, and training resumes and converges —
