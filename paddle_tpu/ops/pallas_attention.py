@@ -39,18 +39,27 @@ NEG_INF = -1e30
 _BLOCK_OVERRIDE = None
 
 
-# Per-(seq, causal) tuned tiles, round-5 chained sweep on v5e at D=64
+# Per-(seq, causal) tuned tiles, round-5 chained sweeps on v5e at D=64
 # (tools/flash_block_sweep.py, docs/PERF.md): wide streamed-K blocks win
-# at these shapes — (512, 2048) is ~10% over 1024^2 at 2048/4096
-# non-causal, and (256, 2048) is ~27% over 1024^2 at 2048 causal (the
-# whole K/V row sits in one block, so the mask is applied in-register
-# instead of paying per-block grid iterations). Shapes not in the table
-# fall back to the biggest power-of-two tile <= 1024 dividing T.
+# every non-causal shape measured — (512, 2048) is +10% over 1024^2 at
+# 2048/4096 and +13% at 8192 — while causal keeps 1024^2 at >=4096
+# (ties at 8192, loses at 4096) and takes (256, 2048) at 2048 (+27%:
+# the whole K/V row sits in one block, so the mask applies in-register
+# instead of paying per-block grid iterations). Non-causal T >= 2048
+# generalizes the measured pattern; other shapes fall back to the
+# biggest power-of-two tile <= 1024 dividing T.
 _BLOCK_TABLE = {
     (2048, True): (256, 2048),
-    (2048, False): (512, 2048),
-    (4096, False): (512, 2048),
 }
+
+
+def _table_blk(T, causal):
+    tbl = _BLOCK_TABLE.get((int(T), bool(causal)))
+    if tbl is not None:
+        return tbl
+    if not causal and T >= 2048 and T % 2048 == 0:
+        return (512, 2048)
+    return None
 
 
 def _blk(T, causal=False):
@@ -66,7 +75,7 @@ def _blk(T, causal=False):
         bq, bk = _BLOCK_OVERRIDE
         if T % bq == 0 and T % bk == 0:
             return bq, bk
-    tbl = _BLOCK_TABLE.get((int(T), bool(causal)))
+    tbl = _table_blk(T, causal)
     if tbl is not None and T % tbl[0] == 0 and T % tbl[1] == 0:
         return tbl
     for b in (1024, 512, 256, 128):

@@ -95,29 +95,27 @@ class PSClient:
     def push_grad(self, endpoint, name, grad):
         self._call(endpoint, "push_grad", name=name, grad=np.asarray(grad))
 
-    def get_params_parallel(self, by_ep: Dict[str, List[str]]
-                            ) -> Dict[str, Dict[str, np.ndarray]]:
-        """One batched get per endpoint, endpoints in parallel (reference
-        AsyncGetVar overlap, grpc_client.cc:122)."""
-        if len(by_ep) <= 1:
-            return {ep: self._call(ep, "get_params", names=names)
-                    for ep, names in by_ep.items()}
-        futs = {ep: self._pool.submit(self._call, ep, "get_params",
-                                      names=names)
-                for ep, names in by_ep.items()}
+    def _fanout(self, cmd: str, payload_by_ep: Dict[str, dict]
+                ) -> Dict[str, object]:
+        """One RPC per endpoint, endpoints in parallel (reference
+        AsyncSendVar/AsyncGetVar handle overlap, grpc_client.cc:66/:122).
+        Single-endpoint calls skip the pool."""
+        if len(payload_by_ep) <= 1:
+            return {ep: self._call(ep, cmd, **payload)
+                    for ep, payload in payload_by_ep.items()}
+        futs = {ep: self._pool.submit(self._call, ep, cmd, **payload)
+                for ep, payload in payload_by_ep.items()}
         return {ep: f.result() for ep, f in futs.items()}
 
+    def get_params_parallel(self, by_ep: Dict[str, List[str]]
+                            ) -> Dict[str, Dict[str, np.ndarray]]:
+        return self._fanout("get_params",
+                            {ep: {"names": names}
+                             for ep, names in by_ep.items()})
+
     def push_grads_parallel(self, by_ep: Dict[str, Dict[str, np.ndarray]]):
-        """One batched push per endpoint, endpoints in parallel (reference
-        AsyncSendVar overlap, grpc_client.cc:66)."""
-        if len(by_ep) <= 1:
-            for ep, grads in by_ep.items():
-                self._call(ep, "push_grads", grads=grads)
-            return
-        futs = [self._pool.submit(self._call, ep, "push_grads", grads=grads)
-                for ep, grads in by_ep.items()]
-        for f in futs:
-            f.result()
+        self._fanout("push_grads",
+                     {ep: {"grads": grads} for ep, grads in by_ep.items()})
 
     # -- sparse -------------------------------------------------------------
     def init_table(self, name, rows, width, dtype, init_low, init_high,
@@ -169,24 +167,14 @@ class PSClient:
     def push_grads_sync(self, by_ep: Dict[str, Dict[str, np.ndarray]]):
         """Batched per-endpoint sends whose updates are DEFERRED to the
         sync_apply barrier (reference kRequestSend accumulation)."""
-        if len(by_ep) <= 1:
-            for ep, grads in by_ep.items():
-                self._call(ep, "push_grads_sync", grads=grads)
-            return
-        futs = [self._pool.submit(self._call, ep, "push_grads_sync",
-                                  grads=grads)
-                for ep, grads in by_ep.items()]
-        for f in futs:
-            f.result()
+        self._fanout("push_grads_sync",
+                     {ep: {"grads": grads} for ep, grads in by_ep.items()})
 
     def sync_apply(self, endpoints: Sequence[str]):
         """Per-batch barrier on every server: blocks until ALL trainers
         have pushed and the aggregated update is applied (reference
         batch-barrier + optimize blocks, then kRequestGet unblocks)."""
-        futs = [self._pool.submit(self._call, ep, "sync_apply")
-                for ep in endpoints]
-        for f in futs:
-            f.result()
+        self._fanout("sync_apply", {ep: {} for ep in endpoints})
 
     # -- control ------------------------------------------------------------
     def barrier(self):

@@ -56,9 +56,11 @@ class _SparseTable:
 
 
 class ParameterServer:
-    def __init__(self, endpoint: str, trainers: int = 1):
+    def __init__(self, endpoint: str, trainers: int = 1,
+                 sync_timeout: float = 120.0):
         self.endpoint = endpoint
         self.trainers = trainers
+        self.sync_timeout = sync_timeout
         self._dense: Dict[str, np.ndarray] = {}
         self._sparse: Dict[str, _SparseTable] = {}
         self._optim: Dict[str, object] = {}
@@ -250,17 +252,22 @@ class ParameterServer:
 
     def _h_sync_apply(self):
         try:
-            self._sync_barrier.wait(timeout=120)
+            self._sync_barrier.wait(timeout=self.sync_timeout)
         except threading.BrokenBarrierError:
-            # recover rather than poison the long-lived server: discard
-            # the incomplete batch's accumulated gradients (a retry must
-            # start clean, never double-apply) and reset the barrier so a
-            # retrying or restarted trainer can proceed. The `broken`
-            # check keeps a second recovering thread from resetting a
-            # barrier fresh waiters have already entered.
+            # recover rather than poison the long-lived server: the FIRST
+            # recovering thread (the one that still observes the barrier
+            # broken, under the lock) discards the incomplete batch's
+            # accumulated gradients and resets the barrier; later
+            # recoverers skip both, so gradients a fast trainer already
+            # RE-pushed for the retry are never wiped. Known limitation,
+            # on the record: with multiple servers a partial failure (one
+            # server's barrier trips, another's completes) makes the
+            # retried batch double-advance the healthy shard — full
+            # exactly-once semantics needs batch-id tagging, which the
+            # reference's sync loop does not provide either.
             with self._pending_lock:
-                self._pending.clear()
                 if self._sync_barrier.broken:
+                    self._pending.clear()
                     self._sync_barrier.reset()
             return ("err", "sync barrier broken (a trainer died or timed "
                            "out mid-batch); batch discarded, barrier "

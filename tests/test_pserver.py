@@ -289,6 +289,54 @@ def test_sync_ps_refuses_sparse_and_collective_runtime_has_no_pserver():
         SyncPSTrainer(t2, exe)
 
 
+def test_sync_barrier_break_recovers_cleanly():
+    """A straggler past the sync timeout breaks the barrier; the server
+    must discard the incomplete batch, reset, and serve the retry with
+    BOTH trainers' fresh gradients applied exactly once — no half-
+    weighted update, no permanent poisoning (round-5 review)."""
+    import threading
+
+    srv = ParameterServer("127.0.0.1:0", trainers=2,
+                          sync_timeout=1.5).start()
+    try:
+        c = PSClient([srv.endpoint])
+        w0 = np.zeros((3,), np.float32)
+        c.init_param(srv.endpoint, "w", w0, "sgd", lr=1.0, attrs={})
+
+        # batch 1: only trainer A pushes + waits -> barrier breaks
+        c.push_grads_sync({srv.endpoint: {"w": np.ones(3, np.float32)}})
+        with pytest.raises(RuntimeError, match="barrier broken"):
+            c.sync_apply([srv.endpoint])
+        np.testing.assert_array_equal(c.get_param(srv.endpoint, "w"), w0)
+
+        # retry: BOTH trainers push fresh grads, both hit the barrier
+        errs = []
+
+        def trainer(g):
+            try:
+                cc = PSClient([srv.endpoint])
+                cc.push_grads_sync(
+                    {srv.endpoint: {"w": np.full(3, g, np.float32)}})
+                cc.sync_apply([srv.endpoint])
+                cc.close()
+            except BaseException as e:
+                errs.append(e)
+
+        ths = [threading.Thread(target=trainer, args=(g,))
+               for g in (1.0, 3.0)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=30)
+        assert not errs, errs
+        # SGD lr 1.0 on mean(1, 3) = 2.0, applied exactly ONCE
+        np.testing.assert_allclose(c.get_param(srv.endpoint, "w"),
+                                   w0 - 2.0)
+        c.close()
+    finally:
+        srv.stop()
+
+
 def test_pserver_crash_restart_resumes_training(tmp_path):
     """Kill one pserver mid-async-DeepFM, restart it on the same endpoint
     from its shard snapshot, and training resumes and converges —
