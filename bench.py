@@ -323,26 +323,124 @@ def _release(jax):
     gc.collect()
 
 
+# Progressive result record: every derived metric lands here as soon as
+# it is measured, so the hang watchdog can emit a PARTIAL-but-valid JSON
+# line if the process wedges inside a native call later on.
+_PARTIAL = {"value": 0.0, "extra": {}}
+_DONE = None  # threading.Event, set when main() prints normally
+
+
+def _emit_partial_and_exit():
+    _PARTIAL["extra"]["bench_watchdog"] = (
+        "global watchdog fired: a segment hung in a native call (dead "
+        "tunnel?); metrics below were measured before the hang, the "
+        "rest are absent")
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": _PARTIAL["value"],
+        "unit": "images/sec",
+        "vs_baseline": round(_PARTIAL["value"] / BASELINE_IMG_PER_SEC, 2),
+        "extra": _PARTIAL["extra"],
+    }))
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(1)
+
+
 def main():
     import jax
     import paddle_tpu as fluid
     from paddle_tpu import models
 
-    peak = measure_peak_tflops(jax) * 1e12
+    import signal
+    import threading
+
+    # SIGALRM breaks Python-level hangs per segment; it CANNOT interrupt
+    # a thread blocked inside a native PJRT/compile call, so a global
+    # watchdog thread guarantees the driver still receives a (partial)
+    # JSON line: after 80 minutes it prints everything measured so far
+    # and hard-exits.
+    global _DONE
+    _DONE = threading.Event()
+
+    watchdog_s = float(os.environ.get("BENCH_WATCHDOG_S", 80 * 60))
+
+    def _watchdog():
+        if not _DONE.wait(watchdog_s):
+            _emit_partial_and_exit()
+
+    threading.Thread(target=_watchdog, daemon=True,
+                     name="bench-watchdog").start()
+
+    def note(**kv):
+        _PARTIAL["extra"].update(kv)
+
+    def seg(label, fn, default, timeout_s=900):
+        """Fault isolation per sub-bench: a transient infra failure (the
+        remote compile server drops connections and occasionally goes
+        away entirely mid-run — observed killing a whole bench at the
+        seq-4096 compile) must cost ONE metric, not the entire recorded
+        JSON line. A dead tunnel HANGS rather than raising, so each
+        segment also runs under a SIGALRM hang-breaker (Python-level
+        hangs; native hangs fall to the global watchdog). Failed
+        segments report their sentinel defaults, which check_claims
+        flags as MEASUREMENT-FAILED."""
+        def _alarm(signum, frame):
+            raise TimeoutError(f"segment exceeded {timeout_s}s")
+
+        prev = signal.signal(signal.SIGALRM, _alarm)
+        signal.alarm(timeout_s)
+        try:
+            return fn()
+        except Exception as e:
+            print(f"WARNING: bench segment {label!r} failed ({e!r}); "
+                  f"recording sentinel", file=sys.stderr)
+            return default
+        finally:
+            # re-arm a short breaker over the cleanup too: _release talks
+            # to the device and can itself hang on a dead tunnel
+            signal.alarm(120)
+            try:
+                _release(jax)
+            except Exception:
+                pass
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, prev)
+
+    try:
+        peak = measure_peak_tflops(jax) * 1e12
+    except Exception as e:
+        # MFU needs SOME denominator; the measured envelope across
+        # recorded rounds is 191.5-194, its midpoint is the least-wrong
+        # stand-in and the warning makes the substitution visible
+        print(f"WARNING: peak probe failed ({e!r}); using the recorded "
+              f"envelope midpoint 192.6 TFLOP/s", file=sys.stderr)
+        peak = 192.6e12
+    note(measured_peak_tflops_bf16=round(peak / 1e12, 1))
 
     # headline (transformer-base unfused) runs FIRST: measured rates in
     # this process drop a few % once the ResNet/flash benches have run
     # (allocator/compile-cache residue), and the headline is the number
     # the north star is judged on
-    tok_unf, tf_fps = bench_transformer(fluid, models, jax, seq_len=256,
-                                        batch_size=64, fused=False,
-                                        want_flops=True)
-    tok_fus, _ = bench_transformer(fluid, models, jax, seq_len=256,
-                                   batch_size=64, fused=True)
-    _release(jax)
+    tok_unf, tf_fps = seg(
+        "transformer256_unfused",
+        lambda: bench_transformer(fluid, models, jax, seq_len=256,
+                                  batch_size=64, fused=False,
+                                  want_flops=True), (0.0, 0.0))
+    note(transformer_base_wmt_tokens_per_sec=round(tok_unf, 0),
+         transformer_mfu=round(tf_fps / peak, 3))
+    tok_fus, _ = seg(
+        "transformer256_flash",
+        lambda: bench_transformer(fluid, models, jax, seq_len=256,
+                                  batch_size=64, fused=True), (0.0, 0.0))
+    note(transformer_base_wmt_tokens_per_sec_flash=round(tok_fus, 0))
 
-    ips, rn_fps = bench_resnet(fluid, models, jax, want_flops=True)
-    _release(jax)
+    ips, rn_fps = seg(
+        "resnet50",
+        lambda: bench_resnet(fluid, models, jax, want_flops=True),
+        (0.0, 0.0))
+    _PARTIAL["value"] = round(ips, 2)
+    note(resnet50_mfu=round(rn_fps / peak, 3))
     # like-for-like pair at long context (flash attention territory).
     # MFU for the flash configs reuses the UNFUSED program's XLA-counted
     # FLOPs-per-token: the Pallas kernel is a custom call whose FLOPs XLA
@@ -350,16 +448,20 @@ def main():
     # steps=12 (not 8): the 2048 pair is the recorded bench's noisiest
     # number (r4 recorded 1.26x where same-process measurement gives
     # ~1.4x) — longer windows put more device time behind each slope
-    tok_long_unf, tf2k_fps = bench_transformer(fluid, models, jax,
-                                               seq_len=2048, batch_size=8,
-                                               fused=False, steps=12,
-                                               warmup=3, want_flops=True)
-    tok_long_fus, _ = bench_transformer(fluid, models, jax, seq_len=2048,
-                                        batch_size=8, fused=True, steps=12,
-                                        warmup=3)
-    _release(jax)
+    tok_long_unf, tf2k_fps = seg(
+        "transformer2048_unfused",
+        lambda: bench_transformer(fluid, models, jax, seq_len=2048,
+                                  batch_size=8, fused=False, steps=12,
+                                  warmup=3, want_flops=True), (0.0, 0.0))
+    tok_long_fus, _ = seg(
+        "transformer2048_flash",
+        lambda: bench_transformer(fluid, models, jax, seq_len=2048,
+                                  batch_size=8, fused=True, steps=12,
+                                  warmup=3), (0.0, 0.0))
     flops_per_tok_2k = tf2k_fps / tok_long_unf if tok_long_unf else 0.0
     fus2k_fps = flops_per_tok_2k * tok_long_fus
+    note(transformer_seq2048_flash_tokens_per_sec=round(tok_long_fus, 0),
+         transformer_seq2048_unfused_tokens_per_sec=round(tok_long_unf, 0))
     # seq-4096 pair: flash territory (the 8192 point is not benched here —
     # the unfused side cannot compile at all: its O(T^2) score tensors
     # need ~37.5 GB vs the chip's 15.75 GB; see docs/PERF.md)
@@ -367,17 +469,23 @@ def main():
     # the 15.75 GB chip at batch 4 in a fresh process and not at all after
     # the earlier benches' residue (tools/flash_longctx_bench.py measures
     # the bs4 pair standalone)
-    tok_4k_unf, _ = bench_transformer(fluid, models, jax, seq_len=4096,
-                                      batch_size=2, fused=False, steps=8,
-                                      warmup=3)
-    _release(jax)
-    tok_4k_fus, _ = bench_transformer(fluid, models, jax, seq_len=4096,
-                                      batch_size=2, fused=True, steps=8,
-                                      warmup=3)
-    _release(jax)
+    tok_4k_unf, _ = seg(
+        "transformer4096_unfused",
+        lambda: bench_transformer(fluid, models, jax, seq_len=4096,
+                                  batch_size=2, fused=False, steps=8,
+                                  warmup=3), (0.0, 0.0))
+    tok_4k_fus, _ = seg(
+        "transformer4096_flash",
+        lambda: bench_transformer(fluid, models, jax, seq_len=4096,
+                                  batch_size=2, fused=True, steps=8,
+                                  warmup=3), (0.0, 0.0))
+    note(transformer_seq4096_flash_tokens_per_sec=round(tok_4k_fus, 0),
+         transformer_seq4096_unfused_tokens_per_sec=round(tok_4k_unf, 0))
     feeder = feeder_overlap_subprocess()
-    lstm_tok, lstm_ex = bench_stacked_lstm(fluid, models, jax)
-    _release(jax)
+    lstm_tok, lstm_ex = seg(
+        "stacked_lstm",
+        lambda: bench_stacked_lstm(fluid, models, jax), (0.0, 0.0))
+    note(stacked_lstm_examples_per_sec=round(lstm_ex, 1))
     # the headline pair is drift-sensitive through the dev tunnel, and
     # the noise is ONE-SIDED: a stall can only lower a reading below the
     # true device rate, never raise it (the device cannot run faster
@@ -385,24 +493,27 @@ def main():
     # keep the max — the less-biased estimator under one-sided noise
     # (recorded spread without this: 229.8-249.7k tok/s across runs of
     # one build).
-    tok_unf2, tf_fps2 = bench_transformer(fluid, models, jax, seq_len=256,
-                                          batch_size=64, fused=False,
-                                          want_flops=True)
+    tok_unf2, tf_fps2 = seg(
+        "transformer256_remeasure",
+        lambda: bench_transformer(fluid, models, jax, seq_len=256,
+                                  batch_size=64, fused=False,
+                                  want_flops=True), (0.0, 0.0))
     if tf_fps2 > 0 and tf_fps <= 0 and tok_unf2 > 0:
         # first FLOPs probe failed but the second succeeded: FLOPs/token
         # is rate-independent, so rescale to the kept token rate
         tf_fps = tf_fps2 * (tok_unf / tok_unf2)
     if tok_unf2 > tok_unf and tf_fps2 > 0:   # never adopt a failed probe
         tok_unf, tf_fps = tok_unf2, tf_fps2
-    _release(jax)
     # ResNet gets the same one-sided-noise treatment (it is the file's
     # primary metric and now runs after the transformer pair)
-    ips2, rn_fps2 = bench_resnet(fluid, models, jax, want_flops=True)
+    ips2, rn_fps2 = seg(
+        "resnet50_remeasure",
+        lambda: bench_resnet(fluid, models, jax, want_flops=True),
+        (0.0, 0.0))
     if rn_fps2 > 0 and rn_fps <= 0 and ips2 > 0:
         rn_fps = rn_fps2 * (ips / ips2)
     if ips2 > ips and rn_fps2 > 0:
         ips, rn_fps = ips2, rn_fps2
-    _release(jax)
     gated = tpu_gated_tests()
 
     extra = {
@@ -429,6 +540,7 @@ def main():
     drift = check_claims(extra)
     if drift:
         extra["claim_drift"] = drift
+    _DONE.set()   # normal completion: the watchdog stands down
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(ips, 2),

@@ -105,6 +105,25 @@ PHASE3 = [
     ("vmem32M", {"xla_tpu_scoped_vmem_limit_kib": "32768"}),
 ]
 
+# Phase R (--model resnet --phase r): conv-program knobs. ResNet-50 is
+# HBM-roofline-bound (docs/PERF.md) and the transformer's vmem winner
+# HURTS it (-7%), so this sweep asks whether any conv-targeted option
+# helps instead.
+PHASER = [
+    ("baseline", {}),
+    ("conv_in_fusion", {"xla_jf_conv_input_fusion": "true"}),
+    ("conv_out_fusion", {"xla_jf_conv_output_fusion": "true"}),
+    ("conv_in+out", {"xla_jf_conv_input_fusion": "true",
+                     "xla_jf_conv_output_fusion": "true"}),
+    ("vmem8M", {"xla_tpu_scoped_vmem_limit_kib": "8192"}),
+    ("vmem24M", {"xla_tpu_scoped_vmem_limit_kib": "24576"}),
+    ("copy_bw2", {"xla_tpu_async_copy_bandwidth_scaling_factor": "2.0"}),
+    ("nd_chunks", {"xla_tpu_nd_short_transfer_max_chunks": "4096"}),
+    ("bundle_cost_model",
+     {"xla_tpu_use_bundle_aware_cost_model_for_fusions": "true"}),
+    ("baseline", {}),   # re-anchor
+]
+
 _V32 = {"xla_tpu_scoped_vmem_limit_kib": "32768"}
 # Phase 4 (--phase 4): the remaining phase-1 mild winners stacked ON TOP
 # of the shipped vmem32M, plus a finer vmem grid around 32 MiB — chasing
@@ -157,15 +176,21 @@ def build_framework_runner(seq_len=256, batch_size=64, fused=False):
                   scope=scope)
     np.asarray(out[0])
 
+    return _make_lowered_runner(exe, scope, batch)
+
+
+def _make_lowered_runner(exe, scope, batch):
+    """Shared tail of every framework-style runner: pick the largest
+    compiled step in the executor cache, lower it once, and return a
+    window factory that threads the DONATED mut state through every
+    config — re-starting a config from the initial state would pass
+    deleted arrays (each call invalidates the buffers it was handed)."""
     compiled = max(exe._cache.values(),
                    key=lambda c: len(c.program.global_block().ops))
     mut0 = {n: scope.find_var(n) for n in compiled.mut_names}
     const = {n: scope.find_var(n) for n in compiled.const_names}
     feeds = {k: batch[k] for k in sorted(batch)}
     lowered = compiled._step.lower(feeds, mut0, const, np.uint32(0))
-    # ONE state shared across every config: each compiled step donates the
-    # mut buffers it is handed, so the live state must thread through all
-    # configs — re-starting a config from `mut0` would pass deleted arrays
     state = {"mut": dict(mut0)}
 
     def make_window(c):
@@ -183,6 +208,35 @@ def build_framework_runner(seq_len=256, batch_size=64, fused=False):
         return window
 
     return lowered, make_window
+
+
+def build_resnet_runner(batch_size=128):
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    fluid.flags.set_flag("xla_compiler_options", "none")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        feeds, fetches = models.resnet.build(class_dim=1000, depth=50,
+                                             data_format="NHWC")
+        loss = fetches["loss"]
+        fluid.optimizer.Momentum(learning_rate=0.1,
+                                 momentum=0.9).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0), amp=True)
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    batch = {
+        "image": jax.device_put(rng.rand(batch_size, 224, 224, 3)
+                                .astype(np.float32)),
+        "label": jax.device_put(rng.randint(0, 1000, (batch_size, 1))
+                                .astype(np.int32)),
+    }
+    out = exe.run(main, feed=batch, fetch_list=[loss], return_numpy=False,
+                  scope=scope)
+    np.asarray(out[0])
+    return _make_lowered_runner(exe, scope, batch)
 
 
 def build_yardstick_runner(seq_len=256, batch_size=64):
@@ -232,14 +286,19 @@ def main():
     steps = int(parse_flag(argv, "--steps", "15"))
     out_json = parse_flag(argv, "--json", "")
     phase = parse_flag(argv, "--phase", "1")
-    sweeps = {"2": PHASE2, "3": PHASE3, "4": PHASE4}.get(phase, SWEEPS)
-    tok = 64 * 256
+    sweeps = {"2": PHASE2, "3": PHASE3, "4": PHASE4,
+              "r": PHASER}.get(phase, SWEEPS)
+    # per-model work-items per step, for the printed rate
+    units = {"framework": (64 * 256, "tok"), "yardstick": (64 * 256, "tok"),
+             "resnet": (128, "img")}
 
     targets = []
     if model in ("framework", "both"):
         targets.append(("framework", build_framework_runner()))
     if model in ("yardstick", "both"):
         targets.append(("yardstick", build_yardstick_runner()))
+    if model == "resnet":
+        targets.append(("resnet", build_resnet_runner()))
 
     results = {}
     for name, (lowered, make_window) in targets:
@@ -258,16 +317,25 @@ def main():
             ratio = dt / base_dt if base_dt else float("nan")
             rows.append({"label": label, "opts": opts, "ms": dt * 1e3,
                          "vs_baseline": ratio, "compile_s": comp_s})
+            n_items, unit = units.get(name, (1, "step"))
             print(f"{name:10s} {label:20s} {dt * 1e3:7.2f} ms/step "
-                  f"({tok / dt / 1e3:6.1f}k tok/s) "
+                  f"({n_items / dt:9.1f} {unit}/s) "
                   f"x{ratio:.3f} vs base  [compile {comp_s:.0f}s]",
                   flush=True)
-            # re-anchor the baseline every 6 configs: tunnel drift
+            # re-anchor the baseline every 6 configs: tunnel drift.
+            # tolerate a flaky compile here like everywhere else — a
+            # failed recheck keeps the previous anchor instead of
+            # aborting the sweep
             if i and i % 6 == 0:
-                dt_b, _ = time_config(lowered, make_window, {}, steps)
-                print(f"{name:10s} {'baseline(recheck)':20s} "
-                      f"{dt_b * 1e3:7.2f} ms/step", flush=True)
-                base_dt = dt_b
+                try:
+                    dt_b, _ = time_config(lowered, make_window, {}, steps)
+                except Exception as e:
+                    print(f"{name:10s} {'baseline(recheck)':20s} "
+                          f"FAILED: {e!r:.120}", flush=True)
+                else:
+                    print(f"{name:10s} {'baseline(recheck)':20s} "
+                          f"{dt_b * 1e3:7.2f} ms/step", flush=True)
+                    base_dt = dt_b
         results[name] = rows
 
     if out_json:
