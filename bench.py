@@ -363,7 +363,12 @@ def main():
     global _DONE
     _DONE = threading.Event()
 
-    watchdog_s = float(os.environ.get("BENCH_WATCHDOG_S", 80 * 60))
+    # watchdog > the normal full-run time (~45 min) with real headroom;
+    # under PATHOLOGICAL degradation (every segment crawling to its own
+    # 600 s breaker) the run cannot finish inside any sane budget, and
+    # the watchdog's partial line — everything measured so far — is the
+    # intended outcome, not a failure of the per-segment guarantee
+    watchdog_s = float(os.environ.get("BENCH_WATCHDOG_S", 100 * 60))
 
     def _watchdog():
         if not _DONE.wait(watchdog_s):
@@ -375,7 +380,7 @@ def main():
     def note(**kv):
         _PARTIAL["extra"].update(kv)
 
-    def seg(label, fn, default, timeout_s=900):
+    def seg(label, fn, default, timeout_s=600):
         """Fault isolation per sub-bench: a transient infra failure (the
         remote compile server drops connections and occasionally goes
         away entirely mid-run — observed killing a whole bench at the
@@ -504,6 +509,8 @@ def main():
         tf_fps = tf_fps2 * (tok_unf / tok_unf2)
     if tok_unf2 > tok_unf and tf_fps2 > 0:   # never adopt a failed probe
         tok_unf, tf_fps = tok_unf2, tf_fps2
+    note(transformer_base_wmt_tokens_per_sec=round(tok_unf, 0),
+         transformer_mfu=round(tf_fps / peak, 3))
     # ResNet gets the same one-sided-noise treatment (it is the file's
     # primary metric and now runs after the transformer pair)
     ips2, rn_fps2 = seg(
@@ -514,6 +521,8 @@ def main():
         rn_fps = rn_fps2 * (ips / ips2)
     if ips2 > ips and rn_fps2 > 0:
         ips, rn_fps = ips2, rn_fps2
+    _PARTIAL["value"] = round(ips, 2)   # keep the partial record adopted
+    note(resnet50_mfu=round(rn_fps / peak, 3))
     gated = tpu_gated_tests()
 
     extra = {

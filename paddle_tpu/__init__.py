@@ -9,6 +9,21 @@ Architecture (see SURVEY.md for the reference blueprint):
   - data parallelism via pjit/GSPMD over a device Mesh (parallel/)
 """
 
+import os as _os
+
+# Honor JAX_PLATFORMS=cpu at import: some environments (the axon dev
+# tunnel) force-register their accelerator backend from sitecustomize
+# and IGNORE the env var, so a subprocess asking for CPU (pserver
+# services, multi-process tests, the embedded C-ABI interpreter) would
+# silently initialize — and hang on, when the tunnel is down — the
+# accelerator backend instead. config.update wins over the sitecustomize
+# override; it must run before the first backend use, which importing
+# this package is about to cause.
+if _os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+
 from .core import ir as _ir
 from .core.ir import (Program, program_guard, default_main_program,  # noqa: F401
                       default_startup_program, Variable, Parameter, Operator)

@@ -9,21 +9,13 @@ NativePaddlePredictor::Run driving the same Executor as python).
 
 from __future__ import annotations
 
-import os
 from typing import List, Tuple
 
 import numpy as np
 
-# Honor JAX_PLATFORMS=cpu for the embedded interpreter: some
-# environments (the axon dev tunnel) force-register their backend from
-# sitecustomize and IGNORE the env var, so a host asking for a CPU
-# predictor would silently run through the accelerator tunnel instead —
-# and hang when the tunnel is down. config.update wins over the
-# sitecustomize override; it must run before the first backend use.
-if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
+# JAX_PLATFORMS=cpu is honored by the paddle_tpu package __init__ (which
+# importing this module executes first): a host asking for a CPU
+# predictor never silently routes through an accelerator tunnel.
 
 
 class Predictor:

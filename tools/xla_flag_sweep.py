@@ -121,7 +121,10 @@ PHASER = [
     ("nd_chunks", {"xla_tpu_nd_short_transfer_max_chunks": "4096"}),
     ("bundle_cost_model",
      {"xla_tpu_use_bundle_aware_cost_model_for_fusions": "true"}),
-    ("baseline", {}),   # re-anchor
+    # distinct label: a second "baseline" entry would re-anchor base_dt
+    # BEFORE its ratio prints (always x1.000); this one reports the
+    # actual drift vs the opening anchor
+    ("baseline_drift_check", {}),
 ]
 
 _V32 = {"xla_tpu_scoped_vmem_limit_kib": "32768"}
