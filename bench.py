@@ -45,6 +45,12 @@ CLAIMS = {
     "flash_vs_unfused_seq4096": (1.30, 1.75),
     "stacked_lstm_examples_per_sec": (3_500, 15_000),
     "feeder_overlap_speedup_cpu_demo": (1.3, 2.3),
+    # round 6: host dispatch overhead, prepared vs the pre-round-6 run()
+    # path (tools/step_overhead_bench.py, CPU subprocess — host-side
+    # python, backend-independent). The floor of 2.0 is the acceptance
+    # criterion; the ceiling is generous because the measured ratio
+    # divides two µs-scale medians on a shared 1-core box
+    "step_overhead_reduction_x": (2.0, 500.0),
 }
 
 
@@ -291,6 +297,28 @@ def feeder_overlap_subprocess():
         return {"feeder_overlap_speedup_cpu_demo": 0.0}
 
 
+def step_overhead_subprocess():
+    """Host dispatch µs/step, prepared vs unprepared: run
+    tools/step_overhead_bench.py in a SUBPROCESS on the CPU backend (host
+    dispatch is backend-independent python, and this process already owns
+    the TPU backend — same isolation rationale as the feeder demo)."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools",
+                "step_overhead_bench.py")],
+            capture_output=True, text=True, timeout=600)
+        line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+        return json.loads(line)
+    except Exception as e:
+        print(f"WARNING: step overhead bench failed ({e!r})",
+              file=sys.stderr)
+        return {"step_overhead_us": 0.0, "step_overhead_us_unprepared": 0.0,
+                "step_overhead_reduction_x": 0.0}
+
+
 def tpu_gated_tests():
     """The TPU-gated flash-dropout + long-context suites must pass on the
     CURRENT build at bench time (round-4 verdict item 10)."""
@@ -491,13 +519,22 @@ def main():
         "stacked_lstm",
         lambda: bench_stacked_lstm(fluid, models, jax), (0.0, 0.0))
     note(stacked_lstm_examples_per_sec=round(lstm_ex, 1))
+    overhead = step_overhead_subprocess()
+    note(step_overhead_us=overhead.get("step_overhead_us", 0.0),
+         step_overhead_us_unprepared=overhead.get(
+             "step_overhead_us_unprepared", 0.0),
+         step_overhead_reduction_x=overhead.get(
+             "step_overhead_reduction_x", 0.0))
     # the headline pair is drift-sensitive through the dev tunnel, and
     # the noise is ONE-SIDED: a stall can only lower a reading below the
     # true device rate, never raise it (the device cannot run faster
     # than device-busy). Re-measure minutes after the first pass and
     # keep the max — the less-biased estimator under one-sided noise
     # (recorded spread without this: 229.8-249.7k tok/s across runs of
-    # one build).
+    # one build). BOTH readings are preserved as *_first/_remeasure
+    # extras so the published JSON keeps the spread behind the
+    # keep-the-max headline (advisor r5).
+    tok_unf_first, tf_fps_first = tok_unf, tf_fps
     tok_unf2, tf_fps2 = seg(
         "transformer256_remeasure",
         lambda: bench_transformer(fluid, models, jax, seq_len=256,
@@ -513,6 +550,7 @@ def main():
          transformer_mfu=round(tf_fps / peak, 3))
     # ResNet gets the same one-sided-noise treatment (it is the file's
     # primary metric and now runs after the transformer pair)
+    ips_first, rn_fps_first = ips, rn_fps
     ips2, rn_fps2 = seg(
         "resnet50_remeasure",
         lambda: bench_resnet(fluid, models, jax, want_flops=True),
@@ -544,6 +582,23 @@ def main():
             feeder.get("feeder_overlap_speedup_cpu_demo", 0.0),
         "stacked_lstm_tokens_per_sec": round(lstm_tok, 0),
         "stacked_lstm_examples_per_sec": round(lstm_ex, 1),
+        # host dispatch per step (CPU subprocess, device time subtracted):
+        # prepared handle vs the pre-round-6 run() dispatch
+        "step_overhead_us": overhead.get("step_overhead_us", 0.0),
+        "step_overhead_us_unprepared": overhead.get(
+            "step_overhead_us_unprepared", 0.0),
+        "step_overhead_reduction_x": overhead.get(
+            "step_overhead_reduction_x", 0.0),
+        # both readings behind the keep-the-max headline metrics, so the
+        # recorded JSON preserves the spread (advisor r5)
+        "transformer_base_wmt_tokens_per_sec_first": round(tok_unf_first, 0),
+        "transformer_base_wmt_tokens_per_sec_remeasure": round(tok_unf2, 0),
+        "transformer_mfu_first": round(tf_fps_first / peak, 3),
+        "transformer_mfu_remeasure": round(tf_fps2 / peak, 3),
+        "resnet50_images_per_sec_first": round(ips_first, 2),
+        "resnet50_images_per_sec_remeasure": round(ips2, 2),
+        "resnet50_mfu_first": round(rn_fps_first / peak, 3),
+        "resnet50_mfu_remeasure": round(rn_fps2 / peak, 3),
         "tpu_gated_tests": gated,
     }
     drift = check_claims(extra)

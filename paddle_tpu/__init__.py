@@ -27,9 +27,10 @@ if _os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
 from .core import ir as _ir
 from .core.ir import (Program, program_guard, default_main_program,  # noqa: F401
                       default_startup_program, Variable, Parameter, Operator)
-from .core.executor import (Executor, Scope, global_scope,  # noqa: F401
-                            CPUPlace, TPUPlace, CUDAPlace, EOFException,
-                            scope_guard, _switch_scope, fetch_var)
+from .core.executor import (Executor, PreparedProgram, Scope,  # noqa: F401
+                            global_scope, CPUPlace, TPUPlace, CUDAPlace,
+                            EOFException, scope_guard, _switch_scope,
+                            fetch_var)
 from .core.backward import append_backward, calc_gradient  # noqa: F401
 
 from . import ops  # noqa: F401  (registers all lowering rules)

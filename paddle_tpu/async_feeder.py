@@ -30,13 +30,20 @@ class AsyncFeeder:
     """
 
     def __init__(self, feeder, reader: Callable[[], Iterable], capacity: int = 4,
-                 device=None, sharding=None, pad_to: int = 0):
+                 device=None, sharding=None, pad_to: int = 0, prepared=None):
         self._feeder = feeder
         self._reader = reader
         self._capacity = capacity
         self._device = device
         self._sharding = sharding
         self._pad_to = pad_to
+        if prepared is not None and device is None and sharding is None:
+            # pair with an Executor.prepare() handle: transfers target the
+            # device the prepared step dispatches to, so each batch's H2D
+            # is enqueued (async under PJRT) while the PREVIOUS prepared
+            # step still runs — host dispatch and feed placement overlap
+            # the step end-to-end
+            self._device = prepared.device
 
     def _convert(self, batch) -> Dict:
         """Host-side conversion only — runs on the producer thread."""

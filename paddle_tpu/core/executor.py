@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import ir, registry
+from .. import flags as _flags
 from .lowering import BlockLowerer
 
 logger = logging.getLogger(__name__)
@@ -78,7 +79,13 @@ CUDAPlace = TPUPlace
 
 
 class Scope:
-    """Hierarchical name -> array holder (reference scope.h:39)."""
+    """Hierarchical name -> array holder (reference scope.h:39).
+
+    Mutations bump a version counter shared by the whole scope TREE (kept
+    on the root): prepared programs cache their state gather against it,
+    and find_var walks parents, so a parent mutation must invalidate a
+    child-bound cache too. One counter per tree (not per process) keeps
+    independent scopes from invalidating each other's caches."""
 
     _uid_counter = itertools.count()
 
@@ -88,6 +95,12 @@ class Scope:
         self._kids: List[Scope] = []
         # process-unique id for executor cache keys (id() recycles after GC)
         self._uid = next(Scope._uid_counter)
+        self._root = parent._root if parent is not None else self
+        if parent is None:
+            self._version = 0
+
+    def version(self) -> int:
+        return self._root._version
 
     def new_scope(self) -> "Scope":
         kid = Scope(self)
@@ -96,6 +109,7 @@ class Scope:
 
     def drop_kids(self):
         self._kids = []
+        self._root._version += 1
 
     def var(self, name: str):
         """Get a variable from THIS scope only (no parent lookup); returns
@@ -113,6 +127,7 @@ class Scope:
 
     def set_var(self, name: str, value):
         self._vars[name] = value
+        self._root._version += 1
 
     def has_var(self, name: str) -> bool:
         return self.find_var(name) is not None
@@ -123,6 +138,7 @@ class Scope:
     def erase(self, names: Sequence[str]):
         for n in names:
             self._vars.pop(n, None)
+        self._root._version += 1
 
 
 _global_scope = Scope()
@@ -145,6 +161,63 @@ def _as_feed_array(v, var: Optional[ir.Variable]):
     return arr
 
 
+def _convert_feed_dict(block, feed: Dict[str, Any]) -> Dict[str, Any]:
+    """User feed dict -> array dict, materializing @SEQLEN companions for
+    (data, lengths) LoD feeds. Shared by the unprepared and prepared paths
+    so both produce identical feed signatures."""
+    feed_arrays = {}
+    for name, val in feed.items():
+        var = block.vars.get(name)
+        if isinstance(val, (tuple, list)) and len(val) == 2 and var is not None \
+                and var.lod_level > 0:
+            data, lens = val
+            feed_arrays[name] = _as_feed_array(data, var)
+            if isinstance(lens, (tuple, list)) and len(lens) == 2 \
+                    and not np.isscalar(lens[0]):
+                # nested LoD: (outer counts [B], inner lengths [B, S])
+                feed_arrays[ir.seqlen_var_name(name)] = \
+                    np.asarray(lens[0], np.int32)
+                feed_arrays[ir.seqlen_var_name(name, 1)] = \
+                    np.asarray(lens[1], np.int32)
+            else:
+                feed_arrays[ir.seqlen_var_name(name)] = \
+                    np.asarray(lens, np.int32)
+        else:
+            feed_arrays[name] = _as_feed_array(val, var)
+    return feed_arrays
+
+
+class _StateCache:
+    """Scope-version-keyed cache of a compiled step's (mut, const) state
+    gather. The gather is O(state vars) of find_var walks — pure per-step
+    host overhead once the program is steady — so it is rebuilt only when
+    the scope tree reports a mutation the executor didn't make itself."""
+
+    def __init__(self):
+        self._entry = None
+        self._version = -1
+        self._mut: Optional[Dict[str, Any]] = None
+        self._const: Optional[Dict[str, Any]] = None
+
+    def get(self, entry: "_CompiledProgram", scope: Scope):
+        if (entry is not self._entry or self._mut is None
+                or scope.version() != self._version):
+            self._mut, self._const = entry.gather_state(scope)
+            self._entry = entry
+        return self._mut, self._const
+
+    def commit(self, entry: "_CompiledProgram", scope: Scope, new_state):
+        """Refresh after a step: the mut arrays were donated (dead); swap
+        in the step's outputs, then adopt the scope version the write-back
+        produced so our own set_var calls don't invalidate the cache."""
+        mut = self._mut
+        for n in entry.mut_names:
+            v = new_state.get(n)
+            if v is not None:
+                mut[n] = v
+        self._version = scope.version()
+
+
 def resolve_compiler_options(platform: str, program=None):
     """Per-executable XLA options from the `xla_compiler_options` flag.
 
@@ -158,8 +231,6 @@ def resolve_compiler_options(platform: str, program=None):
     A/Bs actually support. An explicit k=v list applies unconditionally.
     Non-TPU backends get None (the names are TPU-only and other backends
     reject unknown options)."""
-    from .. import flags as _flags
-
     val = _flags.get_flag("xla_compiler_options")
     if val == "auto":
         if platform != "tpu":
@@ -169,22 +240,38 @@ def resolve_compiler_options(platform: str, program=None):
         return {"xla_tpu_scoped_vmem_limit_kib": "32768"}
     if not val or val == "none":
         return None
-    return dict(kv.split("=", 1) for kv in val.split(",") if kv)
+    opts = {}
+    for kv in val.split(","):
+        if not kv:
+            continue
+        if "=" not in kv:
+            raise ValueError(
+                f"xla_compiler_options entry {kv!r} is malformed — expected "
+                f"'name=value' pairs separated by commas (full flag value: "
+                f"{val!r})")
+        k, v = kv.split("=", 1)
+        opts[k] = v
+    return opts
 
 
-_has_conv_cache: Dict[tuple, bool] = {}
+# program uid -> (program version, has_conv). Keyed by uid with the version
+# INSIDE the value so a mutated program replaces its stale entry instead of
+# accreting one per version in a long-lived process.
+_has_conv_cache: Dict[int, tuple] = {}
 
 
 def _program_has_conv(program) -> bool:
-    """Memoized per (program uid, version): run() calls this every step
-    and a full op walk on a large program is avoidable repeated work."""
-    key = (program._uid, program._version)
-    hit = _has_conv_cache.get(key)
-    if hit is None:
-        hit = any("conv" in op.type
+    """Memoized per program uid (latest version wins): run() calls this on
+    bind and a full op walk on a large program is avoidable repeated work."""
+    hit = _has_conv_cache.get(program._uid)
+    if hit is None or hit[0] != program._version:
+        val = any("conv" in op.type
                   for block in program.blocks for op in block.ops)
-        _has_conv_cache[key] = hit
-    return hit
+        if hit is None and len(_has_conv_cache) >= _MAX_TRACKED_PROGRAMS:
+            _has_conv_cache.pop(next(iter(_has_conv_cache)))
+        _has_conv_cache[program._uid] = (program._version, val)
+        return val
+    return hit[1]
 
 
 class _CompiledProgram:
@@ -283,12 +370,26 @@ class _CompiledProgram:
         self._step = jax.jit(step, donate_argnums=donate_args,
                              compiler_options=compiler_options or None)
 
-    def run(self, scope: Scope, feeds: Dict[str, Any], counter):
+    def gather_state(self, scope: Scope):
         mut = {n: scope.find_var(n) for n in self.mut_names}
         const = {n: scope.find_var(n) for n in self.const_names}
+        return mut, const
+
+    def run(self, scope: Scope, feeds: Dict[str, Any], counter):
+        mut, const = self.gather_state(scope)
+        return self.run_with_state(scope, feeds, mut, const, counter)[0]
+
+    def run_with_state(self, scope: Scope, feeds, mut, const, counter):
+        """One step against pre-gathered state dicts; returns (fetches,
+        new_state) so callers holding a state cache can refresh their mut
+        entries (the mut arrays were donated to XLA and are dead after the
+        call)."""
         fetches, new_state, flags = self._step(feeds, mut, const, counter)
-        for n, v in new_state.items():
-            scope.set_var(n, v)
+        # bulk write-back: one dict update + one version bump (set_var per
+        # name costs ~10µs/step on wide optimizers; equality-based cache
+        # invalidation only needs the version to CHANGE, not count)
+        scope._vars.update(new_state)
+        scope._root._version += 1
         if self.check_nan_inf and flags:
             finite = np.asarray(jnp.stack(flags))
             if not finite.all():
@@ -298,14 +399,235 @@ class _CompiledProgram:
                     f"NaN/Inf detected in output {var!r} of op "
                     f"{op_type!r} (check_nan_inf mode; reference "
                     f"CheckTensorNANOrInf, operator.cc:622)")
+        return fetches, new_state
+
+
+# leak backstop for the per-program uid maps (run counters / rng ordinals):
+# a long-lived process churning through distinct Program objects stops
+# growing them past this. Evicting a counter only matters if that exact
+# program runs AGAIN later (its unseeded rng stream restarts), which after
+# 4096 intervening programs is a serving process recycling graphs, not a
+# training loop.
+_MAX_TRACKED_PROGRAMS = 4096
+
+# run()'s PreparedProgram memo cap: unlike the compile cache (whose
+# entries hold no arrays), a prepared handle pins its scope and the
+# gathered state dicts, so the memo is kept small — steady-state loops
+# use only a few handles, and rebuilding an evicted one is cheap.
+_MAX_PREPARED_HANDLES = 64
+
+
+def _evict_stale_versions(cache: Dict[tuple, Any], uid: int, version: int):
+    """Drop cache entries for older versions of a (mutated) program before
+    inserting the current version's — keyed caches would otherwise grow one
+    entry per mutation in long-lived processes (advisor r5). Keys must lead
+    with (program uid, program version)."""
+    stale = [k for k in cache if k[0] == uid and k[1] != version]
+    for k in stale:
+        del cache[k]
+
+
+def _evict_superseded(cache: Dict[tuple, Any], key: tuple, prefix: int = 4):
+    """Drop memo entries that agree with `key` on its first `prefix`
+    fields but differ beyond them (a flag flip re-keys the memo for the
+    same program/feed/fetch/scope — the superseded entry would otherwise
+    leak one handle per flip)."""
+    stale = [k for k in cache if k[:prefix] == key[:prefix] and k != key]
+    for k in stale:
+        del cache[k]
+
+
+class PreparedProgram:
+    """Bound fast-path handle from `Executor.prepare()` (reference
+    Executor::Prepare / RunPreparedContext, executor.cc:294-366; TF's
+    session-handle design serves the same purpose).
+
+    Everything resolvable once per (program, fetch list, scope) — compiler
+    options, flag reads, the listen_and_serv scan, fetch-name resolution —
+    happens at construction; the compiled entry binds lazily on the first
+    `run(feed)` (the feed signature, including @SEQLEN companions, is only
+    knowable from real feed values). After that, each `run(feed)` does
+    only: feed conversion, a scope-version-checked cached state gather, the
+    jitted call, and state write-back. `return_numpy=False` returns the
+    step's `jax.Array` outputs without forcing a host sync, so dispatch of
+    the next step overlaps this step's device execution."""
+
+    def __init__(self, executor: "Executor", program: ir.Program,
+                 fetch_list, scope: Scope, feed_names=None):
+        self._exe = executor
+        self.program = program
+        self.fetch_names = [f.name if isinstance(f, ir.Variable) else str(f)
+                            for f in (fetch_list or [])]
+        self.feed_names = list(feed_names) if feed_names else None
+        self.scope = scope
+        self._block = program.global_block()
+        self._device = executor.place.jax_device()
+        self._program_version = program._version
+        # flag-derived settings are baked at bind time; Executor.run's memo
+        # keys on the flag-registry version, so a set_flag() flip yields a
+        # fresh handle on the next run() (direct handle holders keep the
+        # settings they prepared with — re-prepare to pick up flag flips)
+        self._check_nan_inf = executor.check_nan_inf
+        self._dropout_impl = _flags.get_flag("dropout_impl")
+        self._copts = resolve_compiler_options(self._device.platform, program)
+        ls = [op for op in self._block.ops if op.type == "listen_and_serv"]
+        self._serve_attrs = ls[0].attrs if ls else None
+        self._entries: Dict[tuple, _CompiledProgram] = {}
+        self._entry: Optional[_CompiledProgram] = None
+        self._entry_keys = frozenset()
+        self._feed_plan = None   # bound by _bind (per-name dtype plan)
+        self._plan_keys = frozenset()
+        self._state = _StateCache()
+        # entering jax.default_device() per step costs ~hundreds of µs
+        # (the config context defeats pjit's C++ fast path). Steps that
+        # read ANY scope state don't need it: the state arrays were
+        # committed to the right device at startup/bind, and committed
+        # args pin the execution device. Only an all-feed (stateless)
+        # step, whose numpy args would follow jax's global default,
+        # keeps the context.
+        self._use_device_ctx = True
+
+    @property
+    def device(self):
+        """The jax device this handle dispatches to (AsyncFeeder targets
+        pre-step transfers here)."""
+        return self._device
+
+    def run(self, feed: Optional[Dict[str, Any]] = None,
+            return_numpy: bool = True):
+        # A pserver program (one listen_and_serv op) is a HOST service, not
+        # an XLA computation: serve until stopped, exactly like the
+        # reference's blocking Executor.run on the pserver program
+        # (reference listen_and_serv_op.cc:267).
+        if self._serve_attrs is not None:
+            from ..pserver.server import ParameterServer
+            ps = ParameterServer(self._serve_attrs["endpoint"],
+                                 trainers=self._serve_attrs.get("trainers", 1))
+            ps.serve_forever()
+            return []
+        program = self.program
+        if program._version != self._program_version:
+            raise RuntimeError(
+                "program was mutated after prepare(); prepare() it again "
+                "(Executor.run() re-prepares automatically)")
+        feed = feed or {}
+        # py_reader-fed program: no feed -> pop the next queued batch
+        # (raises EOFException at end of pass, reference read-op contract)
+        if not feed and getattr(program, "_py_reader", None) is not None:
+            feed = program._py_reader.next_feed()
+        # steady state: the feed-conversion PLAN (per-name target dtype,
+        # no LoD) was resolved at bind time, so conversion is one tight
+        # loop without block-var lookups or dtype re-resolution
+        plan = self._feed_plan
+        if plan is not None and feed.keys() == self._plan_keys:
+            feed_arrays = {}
+            for name, val in feed.items():
+                if type(val) is np.ndarray:
+                    dt = plan[name]
+                    if dt is not None and val.dtype != dt \
+                            and val.dtype.kind in "fiub":
+                        val = val.astype(dt)
+                    feed_arrays[name] = val
+                elif isinstance(val, jax.Array):
+                    feed_arrays[name] = val   # pre-placed: never round-trip
+                else:
+                    arr = np.asarray(val)
+                    dt = plan[name]
+                    if dt is not None and arr.dtype != dt \
+                            and arr.dtype.kind in "fiub":
+                        arr = arr.astype(dt)
+                    feed_arrays[name] = arr
+        else:
+            feed_arrays = _convert_feed_dict(self._block, feed)
+        entry = self._entry
+        if entry is None or feed_arrays.keys() != self._entry_keys:
+            entry = self._bind(feed, feed_arrays)
+        counter = self._exe._count_run(program._uid)
+        mut, const = self._state.get(entry, self.scope)
+        if self._use_device_ctx:
+            with jax.default_device(self._device):
+                fetches, new_state = entry.run_with_state(
+                    self.scope, feed_arrays, mut, const, counter)
+        else:
+            fetches, new_state = entry.run_with_state(
+                self.scope, feed_arrays, mut, const, counter)
+        self._state.commit(entry, self.scope, new_state)
+        if return_numpy:
+            fetches = [np.asarray(f) for f in fetches]
         return fetches
+
+    def _build_feed_plan(self, feed):
+        """Per-name target dtype for the bound feed set, resolved once.
+        LoD feeds ((data, lengths) tuples) keep the generic conversion —
+        they expand into @SEQLEN companions the plan doesn't model."""
+        plan = {}
+        for name, val in feed.items():
+            var = self._block.vars.get(name)
+            if var is not None and var.lod_level > 0:
+                # a LoD var may be fed as a plain array on one step and a
+                # (data, lengths) tuple on another — only the generic
+                # conversion models that
+                return None
+            plan[name] = (jnp.dtype(var.dtype)
+                          if var is not None and var.dtype else None)
+        return plan
+
+    def _bind(self, feed, feed_arrays) -> _CompiledProgram:
+        """Resolve the compiled entry for this feed signature, consulting
+        the executor-wide compile cache so re-preparing (e.g. after an
+        unrelated flag flip) never recompiles an unchanged step."""
+        sig = tuple(sorted(feed_arrays))
+        entry = self._entries.get(sig)
+        if entry is None:
+            exe, program = self._exe, self.program
+            copts = self._copts
+            cache_key = (program._uid, program._version, sig,
+                         tuple(self.fetch_names), self.scope._uid, exe.amp,
+                         self._check_nan_inf, self._dropout_impl,
+                         tuple(sorted(copts.items())) if copts else None,
+                         program.random_seed)  # seed is baked into the trace
+            entry = exe._cache.get(cache_key)
+            if entry is None:
+                stream = exe._stream_for(program._uid)
+                with jax.default_device(self._device):
+                    entry = _CompiledProgram(
+                        program, sig, self.fetch_names, self.scope,
+                        donate=True, amp=exe.amp,
+                        check_nan_inf=self._check_nan_inf,
+                        compiler_options=copts, rng_stream=stream)
+                _evict_stale_versions(exe._cache, program._uid,
+                                      program._version)
+                exe._cache[cache_key] = entry
+            self._entries[sig] = entry
+        self._entry = entry
+        self._entry_keys = frozenset(sig)
+        # the ctx can be skipped only when this handle's device IS the
+        # process default: jit outputs are UNCOMMITTED, so a stateful step
+        # with numpy feeds would otherwise migrate to jax's global default
+        # backend (e.g. CPUPlace selected in a TPU-default process) —
+        # place selection must hold even without the per-step ctx
+        try:
+            default_dev = (jax.config.jax_default_device
+                           or jax.local_devices()[0])
+        except Exception:
+            default_dev = None
+        self._use_device_ctx = (self._device != default_dev
+                                or not (entry.mut_names or entry.const_names))
+        self._feed_plan = self._build_feed_plan(feed)
+        self._plan_keys = frozenset(feed)
+        return entry
 
 
 class Executor:
     """Program runner (reference executor.py:224).
 
     `place` selects the device; `exe.run(program, feed=..., fetch_list=...)`
-    matches the reference API. Programs are compiled on first run and cached.
+    matches the reference API. Programs are compiled on first run and
+    cached. `run()` itself rides a memoized `PreparedProgram` (the
+    reference's Prepare/RunPreparedContext split), so steady-state steps
+    skip the per-step cache-key rebuild, flag reads, and full scope state
+    gather; loops that want the last few µs hold a `prepare()` handle
+    directly.
     """
 
     def __init__(self, place: Optional[Place] = None, amp: bool = False,
@@ -318,19 +640,66 @@ class Executor:
         # (a new cache entry compiles with the checks baked in).
         self._check_nan_inf = check_nan_inf
         self._cache: Dict[tuple, _CompiledProgram] = {}
+        self._prepared: Dict[tuple, PreparedProgram] = {}
         self._run_counts: Dict[int, int] = {}  # program uid -> runs so far
         self._prog_order: Dict[int, int] = {}  # program uid -> ordinal
+        self._next_stream = 0  # monotone ordinal source (survives eviction)
 
     @property
     def check_nan_inf(self) -> bool:
         if self._check_nan_inf is None:
-            from .. import flags as _flags
             return _flags.get_flag("check_nan_inf")
         return self._check_nan_inf
 
     @check_nan_inf.setter
     def check_nan_inf(self, value):
         self._check_nan_inf = value
+
+    def _stream_for(self, uid: int) -> int:
+        """Executor-local program ordinal for unseeded rng streams. A
+        monotone counter (not len()) so the leak-backstop eviction can
+        never recycle a live ordinal onto a second program."""
+        po = self._prog_order
+        s = po.get(uid)
+        if s is None:
+            if len(po) >= _MAX_TRACKED_PROGRAMS:
+                po.pop(next(iter(po)))
+            s = self._next_stream
+            self._next_stream += 1
+            po[uid] = s
+        return s
+
+    def _count_run(self, uid: int) -> np.uint32:
+        """PER-PROGRAM run counter: the PRNG key is fold_in(key(seed),
+        runs-of-THIS-program), so a seeded startup re-initializes
+        identically no matter what else this executor ran (cross-
+        executor/mesh parity), while seeded TRAINING still draws a
+        fresh-but-reproducible mask every step (reference random_seed
+        reproducibility with per-step variation — the round-3 dropout
+        contract, tests/test_amp_perf_ops.py)."""
+        rc = self._run_counts
+        n = rc.get(uid)
+        if n is None:
+            n = 0
+            if len(rc) >= _MAX_TRACKED_PROGRAMS:
+                rc.pop(next(iter(rc)))
+        rc[uid] = n + 1
+        return np.uint32(n)
+
+    def prepare(self,
+                program: Optional[ir.Program] = None,
+                feed_names: Optional[Sequence[str]] = None,
+                fetch_list: Optional[Sequence[Union[str, ir.Variable]]] = None,
+                scope: Optional[Scope] = None) -> PreparedProgram:
+        """Resolve the per-step-invariant work ONCE and return a bound
+        `PreparedProgram` whose `run(feed)` is the fast path (reference
+        Executor::Prepare + RunPreparedContext, executor.cc:294-366).
+        `feed_names` is advisory (the real feed signature, including LoD
+        @SEQLEN companions, binds on the first run's actual values)."""
+        program = program or ir.default_main_program()
+        scope = scope or global_scope()
+        return PreparedProgram(self, program, fetch_list, scope,
+                               feed_names=feed_names)
 
     def run(self,
             program: Optional[ir.Program] = None,
@@ -341,82 +710,66 @@ class Executor:
             use_program_cache: bool = True):
         program = program or ir.default_main_program()
         scope = scope or global_scope()
-        feed = feed or {}
-        fetch_list = fetch_list or []
+        if not use_program_cache:
+            return self._run_uncached(program, feed, fetch_list, scope,
+                                      return_numpy)
+        # Thin wrapper over a memoized PreparedProgram: existing callers
+        # get the prepared fast path for free. The memo key is everything
+        # a handle bakes in — program identity+version (covers random_seed
+        # mutation), fetch set, scope, executor settings, and the flag
+        # registry version (one int compare standing in for the per-step
+        # flag reads the old path did).
+        fetch_names = tuple(f.name if isinstance(f, ir.Variable) else str(f)
+                            for f in (fetch_list or ()))
+        key = (program._uid, program._version, fetch_names, scope._uid,
+               self.amp, self._check_nan_inf, _flags.version())
+        prepared = self._prepared.get(key)
+        if prepared is None:
+            prepared = PreparedProgram(self, program, fetch_names, scope)
+            _evict_stale_versions(self._prepared, program._uid,
+                                  program._version)
+            # a flag flip (or check_nan_inf toggle) re-keys the memo for
+            # the SAME (program, fetch set, scope) — drop the superseded
+            # handle (the compiled entries live in self._cache and reuse)
+            _evict_superseded(self._prepared, key)
+            # hard cap (FIFO): a handle pins its scope AND the gathered
+            # state arrays, so per-call temporary scopes (exe.run(prog,
+            # scope=Scope()) in a serving loop) would otherwise keep one
+            # full parameter set alive per call. Evicted handles rebuild
+            # cheaply — the compiled entries stay in self._cache.
+            if len(self._prepared) >= _MAX_PREPARED_HANDLES:
+                self._prepared.pop(next(iter(self._prepared)))
+            self._prepared[key] = prepared
+        return prepared.run(feed, return_numpy=return_numpy)
 
-        # A pserver program (one listen_and_serv op) is a HOST service, not
-        # an XLA computation: serve until stopped, exactly like the
-        # reference's blocking Executor.run on the pserver program
-        # (reference listen_and_serv_op.cc:267).
-        ls = [op for op in program.global_block().ops
-              if op.type == "listen_and_serv"]
+    def _run_uncached(self, program, feed, fetch_list, scope, return_numpy):
+        """use_program_cache=False: compile fresh, bypass both caches
+        (reference semantics; used by tests probing recompilation)."""
+        fetch_names = [f.name if isinstance(f, ir.Variable) else str(f)
+                       for f in (fetch_list or [])]
+        block = program.global_block()
+        ls = [op for op in block.ops if op.type == "listen_and_serv"]
         if ls:
             from ..pserver.server import ParameterServer
             ps = ParameterServer(ls[0].attrs["endpoint"],
                                  trainers=ls[0].attrs.get("trainers", 1))
             ps.serve_forever()
             return []
-
-        # py_reader-fed program: no feed -> pop the next queued batch
-        # (raises EOFException at end of pass, reference read-op contract)
+        feed = feed or {}
         if not feed and getattr(program, "_py_reader", None) is not None:
             feed = program._py_reader.next_feed()
-        fetch_names = [f.name if isinstance(f, ir.Variable) else str(f)
-                       for f in fetch_list]
-
-        block = program.global_block()
-        feed_arrays = {}
-        for name, val in feed.items():
-            var = block.vars.get(name)
-            if isinstance(val, (tuple, list)) and len(val) == 2 and var is not None \
-                    and var.lod_level > 0:
-                data, lens = val
-                feed_arrays[name] = _as_feed_array(data, var)
-                if isinstance(lens, (tuple, list)) and len(lens) == 2 \
-                        and not np.isscalar(lens[0]):
-                    # nested LoD: (outer counts [B], inner lengths [B, S])
-                    feed_arrays[ir.seqlen_var_name(name)] = \
-                        np.asarray(lens[0], np.int32)
-                    feed_arrays[ir.seqlen_var_name(name, 1)] = \
-                        np.asarray(lens[1], np.int32)
-                else:
-                    feed_arrays[ir.seqlen_var_name(name)] = \
-                        np.asarray(lens, np.int32)
-            else:
-                feed_arrays[name] = _as_feed_array(val, var)
-
-        from .. import flags as _flags
+        feed_arrays = _convert_feed_dict(block, feed)
         copts = resolve_compiler_options(self.place.jax_device().platform,
                                          program)
-        cache_key = (program._uid, program._version,
-                     tuple(sorted(feed_arrays)), tuple(fetch_names),
-                     scope._uid, self.amp, self.check_nan_inf,
-                     _flags.get_flag("dropout_impl"),
-                     tuple(sorted(copts.items())) if copts else None,
-                     program.random_seed)  # seed is baked into the trace
-        stream = self._prog_order.setdefault(program._uid,
-                                             len(self._prog_order))
-        compiled = self._cache.get(cache_key) if use_program_cache else None
-        if compiled is None:
-            with jax.default_device(self.place.jax_device()):
-                compiled = _CompiledProgram(program, sorted(feed_arrays),
-                                            fetch_names, scope, donate=True,
-                                            amp=self.amp,
-                                            check_nan_inf=self.check_nan_inf,
-                                            compiler_options=copts,
-                                            rng_stream=stream)
-            if use_program_cache:
-                self._cache[cache_key] = compiled
-
-        # PER-PROGRAM run counter: the PRNG key is fold_in(key(seed),
-        # runs-of-THIS-program), so a seeded startup re-initializes
-        # identically no matter what else this executor ran (cross-
-        # executor/mesh parity), while seeded TRAINING still draws a
-        # fresh-but-reproducible mask every step (reference random_seed
-        # reproducibility with per-step variation — the round-3 dropout
-        # contract, tests/test_amp_perf_ops.py)
-        counter = np.uint32(self._run_counts.get(program._uid, 0))
-        self._run_counts[program._uid] = int(counter) + 1
+        stream = self._stream_for(program._uid)
+        with jax.default_device(self.place.jax_device()):
+            compiled = _CompiledProgram(program, sorted(feed_arrays),
+                                        fetch_names, scope, donate=True,
+                                        amp=self.amp,
+                                        check_nan_inf=self.check_nan_inf,
+                                        compiler_options=copts,
+                                        rng_stream=stream)
+        counter = self._count_run(program._uid)
         with jax.default_device(self.place.jax_device()):
             fetches = compiled.run(scope, feed_arrays, counter)
         if return_numpy:
@@ -425,6 +778,7 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+        self._prepared.clear()
 
 
 import contextlib as _contextlib

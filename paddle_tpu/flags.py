@@ -31,6 +31,16 @@ _DEFS: Dict[str, tuple] = {
 
 _FLAGS: Dict[str, Any] = {}
 
+# bumped on every set_flag: executors key their prepared-program memo on
+# this, turning the per-step "did any flag change?" check into one int
+# compare instead of N registry reads (the flag registry stays the source
+# of truth — a flip still takes effect on the next run call)
+_VERSION = 0
+
+
+def version() -> int:
+    return _VERSION
+
 
 def _coerce(val: str, typ):
     if typ is bool:
@@ -65,6 +75,7 @@ _CHOICES: Dict[str, tuple] = {
 
 
 def set_flag(name: str, value):
+    global _VERSION
     if name not in _FLAGS:
         raise KeyError(f"unknown flag {name!r}; known: {sorted(_FLAGS)}")
     if name in _CHOICES:
@@ -73,6 +84,7 @@ def set_flag(name: str, value):
             raise ValueError(
                 f"flag {name!r} must be one of {_CHOICES[name]}, got {value!r}")
     _FLAGS[name] = value
+    _VERSION += 1
 
 
 def all_flags() -> Dict[str, Any]:

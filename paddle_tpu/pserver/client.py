@@ -164,11 +164,22 @@ class PSClient:
                        row_grads=np.asarray(row_grads)[mask])
 
     # -- sync mode (reference RunSyncLoop) ----------------------------------
-    def push_grads_sync(self, by_ep: Dict[str, Dict[str, np.ndarray]]):
+    def push_grads_sync(self, by_ep: Dict[str, Dict[str, np.ndarray]],
+                        batch_id: Optional[int] = None, trainer_id: int = 0,
+                        session: Optional[str] = None):
         """Batched per-endpoint sends whose updates are DEFERRED to the
-        sync_apply barrier (reference kRequestSend accumulation)."""
+        sync_apply barrier (reference kRequestSend accumulation).
+        `batch_id` must increase monotonically per trainer and stay STABLE
+        across retries of the same batch — the server uses it to reject
+        duplicate accumulation when a partially-failed batch is retried.
+        `session` identifies the trainer PROCESS; a restarted trainer
+        sends a fresh nonce so its restarted id sequence is accepted."""
         self._fanout("push_grads_sync",
-                     {ep: {"grads": grads} for ep, grads in by_ep.items()})
+                     {ep: ({"grads": grads} if batch_id is None else
+                           {"grads": grads, "batch_id": int(batch_id),
+                            "trainer_id": int(trainer_id),
+                            "session": session})
+                      for ep, grads in by_ep.items()})
 
     def sync_apply(self, endpoints: Sequence[str]):
         """Per-batch barrier on every server: blocks until ALL trainers

@@ -70,6 +70,17 @@ class ParameterServer:
         # the aggregated update ONCE before any trainer proceeds
         self._pending: Dict[str, np.ndarray] = {}
         self._pending_lock = threading.Lock()
+        # exactly-once sync accounting: per-trainer highest APPLIED batch
+        # id (keyed under that trainer's session nonce, so a restarted
+        # trainer whose ids restart at 0 gets a fresh watermark instead of
+        # silent drops), plus the (trainer, batch) pairs accumulated into
+        # the CURRENT pending batch — retried pushes for an already-applied
+        # or already-accumulated batch are acknowledged but NOT
+        # re-accumulated (closes the double-advance window on partial
+        # barrier failure across servers)
+        self._sync_applied: Dict[int, int] = {}     # trainer -> batch id
+        self._sync_sessions: Dict[int, object] = {}  # trainer -> nonce
+        self._sync_pending_from: set = set()
         self._sync_barrier = threading.Barrier(trainers,
                                                action=self._apply_pending)
         self._locks: Dict[str, threading.Lock] = {}
@@ -227,10 +238,43 @@ class ParameterServer:
     # (reference RunSyncLoop, listen_and_serv_op.cc:106: kRequestSend from
     # every trainer, then the optimize blocks run once on the aggregated
     # gradients, then kRequestGet unblocks)
-    def _h_push_grads_sync(self, grads):
+    def _h_push_grads_sync(self, grads, batch_id=None, trainer_id=0,
+                           session=None):
         """Accumulate this trainer's gradients for the CURRENT batch; the
-        update is applied at the sync_apply barrier, not here."""
+        update is applied at the sync_apply barrier, not here.
+
+        `batch_id` is a per-trainer monotonically increasing tag (the
+        client keeps it stable across retries of the same batch): a push
+        for a batch this server already APPLIED from this trainer — the
+        partial-failure retry case where another server's barrier broke
+        but this one completed — is acknowledged without re-accumulating,
+        as is a duplicate (trainer, batch) push within the pending batch
+        (e.g. a client resend on a dropped connection). `session` is a
+        per-trainer-process nonce: a RESTARTED trainer restarts its ids
+        at 0 under a new session, which resets its watermark — its pushes
+        must accumulate, not be dropped as stale duplicates. Untagged
+        pushes keep the legacy accumulate-always behavior."""
         with self._pending_lock:
+            if batch_id is not None:
+                if session is not None and \
+                        self._sync_sessions.get(trainer_id) != session:
+                    self._sync_sessions[trainer_id] = session
+                    self._sync_applied.pop(trainer_id, None)
+                    # purge the dead session's pending markers so the new
+                    # session's first push is ACCUMULATED, not dropped as
+                    # a duplicate. (Its gradient bytes, if any, are
+                    # already summed into _pending and cannot be
+                    # subtracted — same as legacy; the barrier timeout
+                    # normally clears that batch before a restart rejoins)
+                    self._sync_pending_from = {
+                        (t, b) for t, b in self._sync_pending_from
+                        if t != trainer_id}
+                if batch_id <= self._sync_applied.get(trainer_id, -1):
+                    return ("ok", "duplicate: batch already applied")
+                key = (trainer_id, batch_id)
+                if key in self._sync_pending_from:
+                    return ("ok", "duplicate: push already accumulated")
+                self._sync_pending_from.add(key)
             for n, g in grads.items():
                 g = np.asarray(g)
                 self._pending[n] = (g if n not in self._pending
@@ -246,6 +290,10 @@ class ParameterServer:
         ParallelExecutor CoeffNumDevice convention)."""
         with self._pending_lock:
             pending, self._pending = self._pending, {}
+            for t, b in self._sync_pending_from:
+                if b > self._sync_applied.get(t, -1):
+                    self._sync_applied[t] = b
+            self._sync_pending_from.clear()
         for n, g in pending.items():
             with self._lock(n):
                 self._optim[n].dense(self._dense[n], g / self.trainers)
@@ -259,15 +307,16 @@ class ParameterServer:
             # broken, under the lock) discards the incomplete batch's
             # accumulated gradients and resets the barrier; later
             # recoverers skip both, so gradients a fast trainer already
-            # RE-pushed for the retry are never wiped. Known limitation,
-            # on the record: with multiple servers a partial failure (one
-            # server's barrier trips, another's completes) makes the
-            # retried batch double-advance the healthy shard — full
-            # exactly-once semantics needs batch-id tagging, which the
-            # reference's sync loop does not provide either.
+            # RE-pushed for the retry are never wiped. The partial-failure
+            # case (one server's barrier trips, another's completes) is
+            # closed by the batch-id tags on push_grads_sync: the healthy
+            # shard rejects the retried batch's pushes as already-applied,
+            # so its barrier fires on an EMPTY pending set and the retried
+            # batch cannot double-advance it.
             with self._pending_lock:
                 if self._sync_barrier.broken:
                     self._pending.clear()
+                    self._sync_pending_from.clear()
                     self._sync_barrier.reset()
             return ("err", "sync barrier broken (a trainer died or timed "
                            "out mid-batch); batch discarded, barrier "

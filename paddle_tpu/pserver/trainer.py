@@ -225,6 +225,14 @@ class SyncPSTrainer(AsyncPSTrainer):
                 "sync PS mode is dense-only: distributed lookup tables "
                 "update barrierlessly (reference runs sparse CTR async); "
                 "use sync_mode=False or mode='hybrid'")
+        # monotone batch tag; advanced only after a SUCCESSFUL sync_apply,
+        # so a retried batch re-pushes under the SAME id and servers that
+        # already applied it reject the duplicate accumulation. The
+        # session nonce distinguishes a RESTARTED trainer (ids restart at
+        # 0 legitimately) from a duplicate push of an applied batch.
+        import uuid
+        self._batch_id = 0
+        self._session = uuid.uuid4().hex
 
     def step(self, feed: Dict, fetch_list: Sequence) -> List[np.ndarray]:
         # 1. recv: params as of the LAST barrier (identical on every
@@ -240,10 +248,17 @@ class SyncPSTrainer(AsyncPSTrainer):
         user_outs = outs[: len(fetch_list)]
         grads = outs[len(fetch_list):]
 
-        # 3. send: accumulate-only pushes ...
-        self.client.push_grads_sync(self._dense_grads_by_ep(grads))
+        # 3. send: accumulate-only pushes, tagged with this trainer's
+        # batch id (stable across retries — servers reject duplicates)
+        self.client.push_grads_sync(self._dense_grads_by_ep(grads),
+                                    batch_id=self._batch_id,
+                                    trainer_id=self.trainer_id,
+                                    session=self._session)
 
         # 4. ... then the per-batch barrier on EVERY server (each counts
-        # all trainers); returning means the aggregated update is applied
+        # all trainers); returning means the aggregated update is applied.
+        # Only a successful apply advances the batch id: a barrier error
+        # propagates and the user's retry re-runs THIS batch id.
         self.client.sync_apply(self.t._pserver_endpoints)
+        self._batch_id += 1
         return user_outs

@@ -179,6 +179,10 @@ class Trainer:
                     self.checkpoint_cfg.epoch_id = args.get("epoch_id", 0)
                     self.checkpoint_cfg.step_id = args.get("step_id", 0)
         self._pe = None
+        # prepared-step handles per fetch set (Executor.prepare): the
+        # train loop's per-step host dispatch skips the key rebuild and
+        # scope state gather entirely
+        self._prepared = {}
 
     def _executor_run(self, feed, fetch_list):
         if self.parallel:
@@ -187,8 +191,21 @@ class Trainer:
                                             loss_name=self.loss.name,
                                             scope=self.scope)
             return self._pe.run(fetch_list=fetch_list, feed=feed)
-        return self.exe.run(self.train_program, feed=feed,
-                            fetch_list=fetch_list, scope=self.scope)
+        from . import flags as _flags
+        key = tuple(f.name if isinstance(f, ir.Variable) else str(f)
+                    for f in fetch_list)
+        # re-prepare when the program mutates or a flag flips — the same
+        # invalidation Executor.run()'s memo provides, so holding the
+        # handle never changes behavior vs the run() path
+        ver = (self.train_program._version, _flags.version(),
+               self.exe._check_nan_inf)
+        hit = self._prepared.get(key)
+        if hit is None or hit[1] != ver:
+            hit = (self.exe.prepare(self.train_program,
+                                    fetch_list=fetch_list,
+                                    scope=self.scope), ver)
+            self._prepared[key] = hit
+        return hit[0].run(feed)
 
     def train(self, num_epochs, event_handler=None, reader=None,
               feed_order=None):

@@ -337,6 +337,53 @@ def test_sync_barrier_break_recovers_cleanly():
         srv.stop()
 
 
+def test_sync_push_batch_ids_reject_duplicate_accumulation():
+    """Batch-id-tagged sync pushes close the double-advance window
+    (round-6 satellite): a retried push for a batch this server already
+    APPLIED — the partial barrier failure case across multiple servers —
+    is acknowledged without re-accumulating, as is a double push of the
+    same (trainer, batch) within a pending batch (client resend)."""
+    srv = ParameterServer("127.0.0.1:0", trainers=1).start()
+    try:
+        c = PSClient([srv.endpoint])
+        w0 = np.zeros((3,), np.float32)
+        c.init_param(srv.endpoint, "w", w0, "sgd", lr=1.0, attrs={})
+
+        # batch 0: push + duplicate push (same trainer, same batch id) —
+        # the duplicate must NOT accumulate
+        g = {srv.endpoint: {"w": np.ones(3, np.float32)}}
+        c.push_grads_sync(g, batch_id=0, trainer_id=0)
+        c.push_grads_sync(g, batch_id=0, trainer_id=0)
+        c.sync_apply([srv.endpoint])
+        np.testing.assert_allclose(c.get_param(srv.endpoint, "w"),
+                                   w0 - 1.0)
+
+        # retry of the ALREADY-APPLIED batch 0 (the healthy-shard leg of a
+        # partial barrier failure): rejected, the barrier fires on an
+        # empty pending set, the param must not double-advance
+        c.push_grads_sync(g, batch_id=0, trainer_id=0)
+        c.sync_apply([srv.endpoint])
+        np.testing.assert_allclose(c.get_param(srv.endpoint, "w"),
+                                   w0 - 1.0)
+
+        # batch 1 proceeds normally afterwards
+        c.push_grads_sync(g, batch_id=1, trainer_id=0)
+        c.sync_apply([srv.endpoint])
+        np.testing.assert_allclose(c.get_param(srv.endpoint, "w"),
+                                   w0 - 2.0)
+
+        # a RESTARTED trainer restarts its batch ids at 0 under a NEW
+        # session nonce: its pushes must accumulate, not be silently
+        # dropped as stale duplicates of the old session's batch 0
+        c.push_grads_sync(g, batch_id=0, trainer_id=0, session="s2")
+        c.sync_apply([srv.endpoint])
+        np.testing.assert_allclose(c.get_param(srv.endpoint, "w"),
+                                   w0 - 3.0)
+        c.close()
+    finally:
+        srv.stop()
+
+
 def test_pserver_crash_restart_resumes_training(tmp_path):
     """Kill one pserver mid-async-DeepFM, restart it on the same endpoint
     from its shard snapshot, and training resumes and converges —
