@@ -57,6 +57,8 @@ from . import recordio_writer  # noqa: F401
 from .recordio_writer import (convert_reader_to_recordio_file,  # noqa: F401
                               convert_reader_to_recordio_files)
 from . import ir_pass  # noqa: F401
+from . import analysis  # noqa: F401
+from .analysis import ProgramVerificationError  # noqa: F401
 from . import enforce  # noqa: F401
 from . import lod_tensor  # noqa: F401
 from .lod_tensor import create_lod_tensor, create_random_int_lodtensor  # noqa: F401

@@ -1,0 +1,47 @@
+"""Static analysis over the Program IR: structural verification,
+whole-program shape/dtype inference, and TPU-fit lints.
+
+The reference ran compile-time InferShape over op descs before execution
+(framework/shape_inference.h:30) and shipped a standalone analysis pass
+manager (inference/analysis/analyzer.cc). This package is the TPU-native
+analog over the JSON-serializable Program IR:
+
+    from paddle_tpu import analysis
+    diags = analysis.analyze_program(prog, fetch_targets=["loss"])
+    print(analysis.format_diagnostics(diags))
+
+Surfaces wired elsewhere: the read-only "verify" pass and the mutating
+"infer_shapes" pass (ir_pass.py), `Executor.prepare(validate=...)` /
+the `validate` flag (core/executor.py, flags.py), transpiler split
+verification (transpiler/distribute_transpiler.py), and the
+`tools/paddle_lint.py` CLI.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..core import ir
+from .diagnostics import (Diagnostic, ProgramVerificationError,  # noqa: F401
+                          Severity, format_diagnostics, has_errors,
+                          lint_program, sort_diagnostics)
+from .shape_infer import check_program_shapes, infer_program_shapes  # noqa: F401
+from .verifier import verify_program  # noqa: F401
+
+
+def analyze_program(program: ir.Program,
+                    feed_targets: Optional[Sequence[str]] = None,
+                    fetch_targets: Optional[Sequence[str]] = None,
+                    shapes: bool = True,
+                    lint: bool = True) -> List[Diagnostic]:
+    """Full sweep: structural verification + shape/dtype cross-check +
+    TPU lints, ranked most-severe-first."""
+    diags = verify_program(program, feed_targets=feed_targets,
+                           fetch_targets=fetch_targets)
+    if shapes and not has_errors(diags):
+        # structural errors make shape propagation garbage-in; the
+        # reference ordered InferShape after desc validation the same way
+        diags += check_program_shapes(program)
+    if lint:
+        diags += lint_program(program, fetch_targets=fetch_targets)
+    return sort_diagnostics(diags)

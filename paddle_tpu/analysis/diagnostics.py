@@ -1,0 +1,223 @@
+"""Severity-ranked diagnostics for the static analysis layer.
+
+Capability parity with the reference's build-time error surface: the C++
+InferShape/OpDesc checks raise EnforceNotMet with an attached call stack
+(reference: paddle/fluid/platform/enforce.h, operator.cc's
+`op_callstack` attr); the inference analyzer emits ordered findings per
+pass (reference: paddle/fluid/inference/analysis/analyzer.cc). Here every
+finding is a `Diagnostic` record carrying (block idx, op idx, op type,
+var) provenance plus the op's trimmed creation traceback captured by
+`Operator.__init__` (core/ir.py), so "op 37 has a bad input" points back
+at the layers-DSL line that built op 37 — not at the XLA lowering that
+tripped over it 40k steps later.
+
+The TPU-specific lints live here too (float64 use, dead ops relative to
+fetch targets, feed-shape recompilation hazards): they are properties of
+the IR that only *matter* on this backend, not structural errors.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..core import ir, registry
+
+
+class Severity(enum.IntEnum):
+    """Ranked: higher = more severe (sort descending for display)."""
+
+    INFO = 1
+    WARNING = 2
+    ERROR = 3
+
+    def __str__(self):
+        return self.name
+
+
+@dataclass
+class Diagnostic:
+    """One finding, with enough provenance to act on it."""
+
+    code: str                 # stable kebab-case id, e.g. "undefined-input"
+    severity: Severity
+    message: str
+    block_idx: int = 0
+    op_idx: Optional[int] = None
+    op_type: Optional[str] = None
+    var: Optional[str] = None
+    site: Optional[List[str]] = None  # trimmed creation traceback (user frames)
+
+    def format(self, show_site: bool = True) -> str:
+        where = f"block {self.block_idx}"
+        if self.op_idx is not None:
+            where += f" op {self.op_idx}"
+        if self.op_type:
+            where += f" ({self.op_type})"
+        out = f"{self.severity}: [{self.code}] {where}: {self.message}"
+        if show_site and self.site:
+            out += "".join(f"\n    built at {s}" for s in self.site)
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"code": self.code, "severity": self.severity.name,
+                "message": self.message, "block_idx": self.block_idx,
+                "op_idx": self.op_idx, "op_type": self.op_type,
+                "var": self.var, "site": self.site}
+
+
+def diag_for_op(code: str, severity: Severity, message: str,
+                block: ir.Block, op_idx: Optional[int] = None,
+                op: Optional[ir.Operator] = None,
+                var: Optional[str] = None) -> Diagnostic:
+    """Build a Diagnostic with provenance pulled off the op itself."""
+    return Diagnostic(
+        code=code, severity=severity, message=message, block_idx=block.idx,
+        op_idx=op_idx, op_type=op.type if op is not None else None, var=var,
+        site=getattr(op, "_creation_site", None))
+
+
+def sort_diagnostics(diags: Sequence[Diagnostic]) -> List[Diagnostic]:
+    """Most severe first; program order within a severity."""
+    return sorted(diags, key=lambda d: (-int(d.severity), d.block_idx,
+                                        d.op_idx if d.op_idx is not None else -1))
+
+
+def has_errors(diags: Sequence[Diagnostic]) -> bool:
+    return any(d.severity == Severity.ERROR for d in diags)
+
+
+def format_diagnostics(diags: Sequence[Diagnostic],
+                       show_site: bool = True) -> str:
+    return "\n".join(d.format(show_site=show_site)
+                     for d in sort_diagnostics(diags))
+
+
+class ProgramVerificationError(ValueError):
+    """Raised by validate="error" surfaces; carries the findings."""
+
+    def __init__(self, diags: Sequence[Diagnostic], context: str = "program"):
+        self.diagnostics = list(diags)
+        errors = [d for d in self.diagnostics if d.severity == Severity.ERROR]
+        super().__init__(
+            f"{context} failed static verification with {len(errors)} "
+            f"error(s):\n{format_diagnostics(self.diagnostics)}")
+
+
+# ---------------------------------------------------------------------------
+# TPU-specific lints (advisory: WARNINGs, never ERRORs)
+# ---------------------------------------------------------------------------
+
+def lint_program(program: ir.Program,
+                 fetch_targets: Optional[Sequence[str]] = None
+                 ) -> List[Diagnostic]:
+    """Backend-fit lints over a structurally valid program."""
+    diags: List[Diagnostic] = []
+    diags += _lint_float64(program)
+    diags += _lint_feed_shape_hazards(program)
+    if fetch_targets:
+        diags += _lint_dead_ops(program, list(fetch_targets))
+    return diags
+
+
+def _lint_float64(program: ir.Program) -> List[Diagnostic]:
+    """float64 has no native TPU support: XLA emulates it in software at
+    a large slowdown (and some ops refuse outright). The reference ran
+    f64 kernels natively on CUDA, so ported configs carry it silently."""
+    diags = []
+    for blk in program.blocks:
+        flagged = set()
+        for v in blk.vars.values():
+            if v.dtype == "float64":
+                diags.append(Diagnostic(
+                    "float64-on-tpu", Severity.WARNING,
+                    f"variable {v.name!r} is float64: TPUs have no native "
+                    f"f64 (software emulation, large slowdown) — use "
+                    f"float32 or bfloat16", block_idx=blk.idx, var=v.name))
+                flagged.add(v.name)
+        for i, op in enumerate(blk.ops):
+            dt = op.attrs.get("dtype")
+            if isinstance(dt, str) and dt in ("float64", "fp64", "double") \
+                    and not (set(op.output_arg_names) & flagged):
+                diags.append(diag_for_op(
+                    "float64-on-tpu", Severity.WARNING,
+                    f"attr dtype={dt!r}: TPUs have no native f64",
+                    blk, i, op))
+    return diags
+
+
+def _lint_feed_shape_hazards(program: ir.Program) -> List[Diagnostic]:
+    """The executor compiles one XLA program per concrete feed shape, so
+    dynamic (-1) dims beyond the batch dim recompile the step on every
+    new extent. A contiguous LEADING run of -1s (batch + time levels) is
+    the documented padded-sequence feed contract — DataFeeder pads and
+    callers bucket — so it rates an INFO note. A -1 sitting AFTER a
+    concrete dim has no such contract: that shape recompiles per batch
+    and is almost always a declaration mistake -> WARNING. LoD
+    (lod_level>0) inputs are the sequence contract by definition."""
+    diags = []
+    for blk in program.blocks:
+        for v in blk.vars.values():
+            if not v.is_data or v.lod_level > 0 or -1 not in v.shape[1:]:
+                continue
+            lead = 0
+            while lead < len(v.shape) and v.shape[lead] == -1:
+                lead += 1
+            trailing_dynamic = any(d == -1 for d in v.shape[lead:])
+            diags.append(Diagnostic(
+                "feed-shape-recompile",
+                Severity.WARNING if trailing_dynamic else Severity.INFO,
+                f"data var {v.name!r} shape {tuple(v.shape)} has a dynamic "
+                f"dim beyond the batch dim: each distinct feed shape "
+                f"compiles a separate XLA program (jit-cache churn) — pad "
+                f"to a fixed extent or bucket feed lengths",
+                block_idx=blk.idx, var=v.name))
+    return diags
+
+
+def _lint_dead_ops(program: ir.Program,
+                   fetch_targets: List[str]) -> List[Diagnostic]:
+    """Ops whose outputs never reach a fetch target, a persistable write
+    (parameter/accumulator updates ARE the point of a training step), or a
+    side-effecting op. Dead ops still trace, compile, and mostly get DCE'd
+    by XLA — but they inflate compile time and hide builder bugs (a loss
+    wired to the wrong var fetches fine and trains nothing)."""
+    diags = []
+    blk = program.global_block()
+    needed = set(fetch_targets)
+    live = [False] * len(blk.ops)
+    for i in range(len(blk.ops) - 1, -1, -1):
+        op = blk.ops[i]
+        out_names = [n for n in op.output_arg_names
+                     if n != registry.EMPTY_VAR]
+        side_effecting = (op.type in _SIDE_EFFECT_OPS
+                          or bool(ir.sub_block_indices(op)))
+        writes_persistable = any(
+            (v := blk._find_var_recursive(n)) is not None and v.persistable
+            for n in out_names)
+        # an op is also live if a LIVE op downstream needs the @SEQLEN
+        # companion of one of its outputs (runtime seqlen propagation
+        # materializes companions without an explicit producing op)
+        companion_hit = any(n + ir.SEQLEN_SUFFIX in needed
+                            for n in out_names)
+        if side_effecting or writes_persistable or companion_hit \
+                or (needed & set(out_names)):
+            live[i] = True
+            ins = {n for n in op.input_arg_names if n != registry.EMPTY_VAR}
+            for si in ir.sub_block_indices(op):
+                ins |= set(ir.external_reads(program, si))
+            needed |= ins
+            needed |= {n + ir.SEQLEN_SUFFIX for n in ins}
+    for i, op in enumerate(blk.ops):
+        if not live[i]:
+            diags.append(diag_for_op(
+                "dead-op", Severity.WARNING,
+                f"op never reaches a fetch target "
+                f"{sorted(fetch_targets)} or a persistable write — "
+                f"mis-wired graph or leftover build code", blk, i, op))
+    return diags
+
+
+_SIDE_EFFECT_OPS = frozenset({"feed", "fetch", "listen_and_serv", "print",
+                              "py_reader", "read", "send", "recv"})

@@ -437,6 +437,48 @@ def _evict_superseded(cache: Dict[tuple, Any], key: tuple, prefix: int = 4):
         del cache[k]
 
 
+# (program uid, version, feed/fetch sig, mode) keys already validated:
+# Executor.run rebuilds PreparedProgram handles on scope churn / flag
+# flips / memo eviction, and re-sweeping an unchanged program each time
+# would defeat PR 1's cheap-rebuild contract. Errors are never cached
+# (they raise); a mutation bumps the version and re-validates.
+_validated: Dict[tuple, bool] = {}
+
+
+def _validate_program(program, mode, feed_names, fetch_names):
+    """`validate` hook shared by prepare()/run(): mode None follows the
+    `validate` flag; "error" raises ProgramVerificationError on ERROR
+    findings, "warn" logs everything found (once per program version),
+    "off" is free."""
+    if mode is None:
+        mode = _flags.get_flag("validate")
+    if mode == "off":
+        return
+    if mode not in ("error", "warn"):
+        raise ValueError(f"validate must be 'error', 'warn' or 'off', "
+                         f"got {mode!r}")
+    key = (program._uid, program._version,
+           tuple(feed_names or ()), tuple(fetch_names or ()), mode)
+    if _validated.get(key):
+        return
+    from .. import analysis
+    # listen_and_serv programs are host services, not XLA computations
+    if not any(op.type == "listen_and_serv"
+               for op in program.global_block().ops):
+        diags = analysis.analyze_program(program, feed_targets=feed_names,
+                                         fetch_targets=fetch_names or None,
+                                         lint=(mode == "warn"))
+        if mode == "error" and analysis.has_errors(diags):
+            raise analysis.ProgramVerificationError(diags)
+        if diags:
+            logger.warning("program validation findings:\n%s",
+                           analysis.format_diagnostics(diags))
+    _evict_stale_versions(_validated, program._uid, program._version)
+    if len(_validated) >= _MAX_TRACKED_PROGRAMS:
+        _validated.pop(next(iter(_validated)))
+    _validated[key] = True
+
+
 class PreparedProgram:
     """Bound fast-path handle from `Executor.prepare()` (reference
     Executor::Prepare / RunPreparedContext, executor.cc:294-366; TF's
@@ -453,7 +495,7 @@ class PreparedProgram:
     the next step overlaps this step's device execution."""
 
     def __init__(self, executor: "Executor", program: ir.Program,
-                 fetch_list, scope: Scope, feed_names=None):
+                 fetch_list, scope: Scope, feed_names=None, validate=None):
         self._exe = executor
         self.program = program
         self.fetch_names = [f.name if isinstance(f, ir.Variable) else str(f)
@@ -461,6 +503,11 @@ class PreparedProgram:
         self.feed_names = list(feed_names) if feed_names else None
         self.scope = scope
         self._block = program.global_block()
+        # flag-gated static verification (analysis/): runs HERE, before
+        # any lowering — a malformed program is rejected with op
+        # provenance instead of a tracer error inside XLA at first run
+        _validate_program(program, validate, self.feed_names,
+                          self.fetch_names)
         self._device = executor.place.jax_device()
         self._program_version = program._version
         # flag-derived settings are baked at bind time; Executor.run's memo
@@ -690,16 +737,20 @@ class Executor:
                 program: Optional[ir.Program] = None,
                 feed_names: Optional[Sequence[str]] = None,
                 fetch_list: Optional[Sequence[Union[str, ir.Variable]]] = None,
-                scope: Optional[Scope] = None) -> PreparedProgram:
+                scope: Optional[Scope] = None,
+                validate: Optional[str] = None) -> PreparedProgram:
         """Resolve the per-step-invariant work ONCE and return a bound
         `PreparedProgram` whose `run(feed)` is the fast path (reference
         Executor::Prepare + RunPreparedContext, executor.cc:294-366).
         `feed_names` is advisory (the real feed signature, including LoD
-        @SEQLEN companions, binds on the first run's actual values)."""
+        @SEQLEN companions, binds on the first run's actual values).
+        `validate="error"|"warn"|"off"` runs the static verifier
+        (analysis/) over the program before anything lowers; None follows
+        the `validate` flag (default off)."""
         program = program or ir.default_main_program()
         scope = scope or global_scope()
         return PreparedProgram(self, program, fetch_list, scope,
-                               feed_names=feed_names)
+                               feed_names=feed_names, validate=validate)
 
     def run(self,
             program: Optional[ir.Program] = None,

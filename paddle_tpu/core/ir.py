@@ -17,6 +17,8 @@ from __future__ import annotations
 import copy
 import itertools
 import json
+import os
+import sys
 from typing import Any, Dict, List, Optional, Sequence
 
 from . import types
@@ -121,6 +123,32 @@ class Parameter(Variable):
         self.sharding = sharding
 
 
+# Package root for trimming creation tracebacks: frames inside the
+# framework are plumbing, the first frames OUTSIDE it are where the user
+# actually built the op (the reference stored the same thing as the
+# `op_callstack` attr on every OpDesc).
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__))) + os.sep
+
+
+def _creation_site(max_frames: int = 2) -> Optional[List[str]]:
+    """Innermost non-framework frames of the current stack, formatted
+    `file:line in func`. Walks raw frame objects (no source loading), so
+    the per-op build cost is a few µs."""
+    try:
+        f = sys._getframe(2)
+    except ValueError:  # pragma: no cover - shallow stack
+        return None
+    site: List[str] = []
+    depth = 0
+    while f is not None and len(site) < max_frames and depth < 32:
+        fn = f.f_code.co_filename
+        if not fn.startswith(_PKG_DIR):
+            site.append(f"{fn}:{f.f_lineno} in {f.f_code.co_name}")
+        f = f.f_back
+        depth += 1
+    return site or None
+
+
 class Operator:
     """One op invocation (reference framework.py:418 / op_desc.h:29).
 
@@ -132,12 +160,17 @@ class Operator:
     def __init__(self, block: "Block", type: str,
                  inputs: Optional[Dict[str, Any]] = None,
                  outputs: Optional[Dict[str, Any]] = None,
-                 attrs: Optional[Dict[str, Any]] = None):
+                 attrs: Optional[Dict[str, Any]] = None,
+                 capture_site: bool = True):
         self.block = block
         self.type = type
         self.inputs = {k: _as_name_list(v) for k, v in (inputs or {}).items() if v is not None}
         self.outputs = {k: _as_name_list(v) for k, v in (outputs or {}).items() if v is not None}
         self.attrs = dict(attrs or {})
+        # trimmed creation traceback for diagnostics (analysis/): not
+        # serialized — a JSON round-trip yields ops with no site, and the
+        # verifier falls back to (block, op index) provenance
+        self._creation_site = _creation_site() if capture_site else None
 
     def input(self, slot: str) -> List[str]:
         return self.inputs.get(slot, [])
@@ -395,7 +428,12 @@ class Program:
                         v.sharding = tuple(vd["sharding"])
                 blk.vars[vd["name"]] = v
             for od in bd["ops"]:
-                blk.ops.append(Operator(blk, od["type"], od["inputs"], od["outputs"], od["attrs"]))
+                # capture_site=False: a deserialized op was not built here
+                # — a captured site would point at whoever called
+                # from_dict, which is noise (and a wasted frame walk/op)
+                blk.ops.append(Operator(blk, od["type"], od["inputs"],
+                                        od["outputs"], od["attrs"],
+                                        capture_site=False))
             p.blocks.append(blk)
         p._current_block_idx = 0
         return p

@@ -26,6 +26,10 @@ from . import types
 # Sentinel size substituted for -1 (unknown batch) dims during build-time shape
 # inference. Prime and large, so it never collides with a real feature dim.
 DIM_SENTINEL = 8191
+# Second, distinct prime for the confirmation trace: a dim is dynamic iff it
+# CHANGES when the sentinel changes (see infer_op_shapes). Divisibility alone
+# cannot classify mixed derivations like concat(dynamic, static) = S+k.
+DIM_SENTINEL_ALT = 7919
 
 EMPTY_VAR = "@EMPTY@"
 GRAD_OP_SUFFIX = "_grad"
@@ -77,10 +81,23 @@ def register_grad(type: str):
     ``grad(ctx, ins: dict, out_grads: dict) -> dict[input_slot, grad]``."""
 
     def deco(fn):
+        if type not in _REGISTRY:
+            close = close_op_names(type)
+            hint = f"; closest registered: {', '.join(close)}" if close else ""
+            raise ValueError(
+                f"register_grad({type!r}): forward op {type!r} is not "
+                f"registered — register_op must run first{hint}")
         _REGISTRY[type].grad_lower = fn
         return fn
 
     return deco
+
+
+def close_op_names(name: str, n: int = 3) -> List[str]:
+    """Registered op types most similar to `name` (typo hints for
+    register_grad and the analysis verifier)."""
+    import difflib
+    return difflib.get_close_matches(name, _REGISTRY, n=n)
 
 
 def get_op_def(type: str) -> OpDef:
@@ -104,6 +121,12 @@ class LoweringContext:
     random ops, threaded functionally through the compiled step (replacing the
     reference's per-op cuRAND states).
     """
+
+    # Sentinel the CURRENT abstract trace substituted for -1 dims: custom
+    # `infer` rules must test dynamicness against this, not the module
+    # constant (infer_op_shapes runs a second trace with DIM_SENTINEL_ALT
+    # to tell sentinel-derived dims from real ones).
+    dim_sentinel = DIM_SENTINEL
 
     def __init__(self, attrs: Dict[str, Any], key=None, lowerer=None, op=None,
                  env=None):
@@ -192,56 +215,92 @@ def call_rule(opdef: OpDef, ctx: LoweringContext, ins_by_slot: Dict[str, List[An
 # Build-time shape inference via eval_shape (reference: InferShape contexts).
 # ---------------------------------------------------------------------------
 
-def _mark_dynamic(shape, had_dynamic_input: bool):
-    out = []
-    for d in shape:
-        d = int(d)
-        # A dim equal to (or a multiple of) the sentinel derives from the
-        # dynamic batch dim -> record as unknown. Mixed sums like SENTINEL+k
-        # (rare: concat of dynamic with static) stay as-is; runtime shapes
-        # remain authoritative.
-        if had_dynamic_input and d >= DIM_SENTINEL and d % DIM_SENTINEL == 0:
-            out.append(-1)
-        else:
-            out.append(d)
-    return tuple(out)
+def _mark_dynamic(shape_a, shape_b):
+    """Classify each output dim by comparing the two sentinel traces: a
+    dim that moved when the sentinel moved derives from the dynamic input
+    dim -> -1. This classifies EVERY arithmetic derivation — identity,
+    multiples (flatten), and mixed sums like concat(dynamic, static) =
+    S+k, which the old divisible-by-sentinel test left as a bogus
+    concrete extent (e.g. 8194) that then poisoned downstream inference."""
+    if shape_b is None:
+        return tuple(int(d) for d in shape_a)
+    return tuple(-1 if int(a) != int(b) else int(a)
+                 for a, b in zip(shape_a, shape_b))
 
 
-def infer_op_shapes(op_type: str, attrs: Dict[str, Any],
-                    ins_by_slot: Dict[str, List[Any]]):
-    """Return {output_slot: [(shape, dtype), ...]} for an op given input
-    (shape, dtype) pairs. -1 dims are substituted with DIM_SENTINEL, traced
-    through the lowering rule abstractly, and mapped back to -1."""
-    opdef = get_op_def(op_type)
-    had_dynamic = False
+def _eval_abstract(opdef, attrs, ins_by_slot, sentinel):
+    """One abstract trace with `sentinel` standing in for -1 dims.
+    Returns {slot: [ShapeDtypeStruct, ...]}."""
     structs: Dict[str, List[jax.ShapeDtypeStruct]] = {}
     for slot, pairs in ins_by_slot.items():
         ss = []
         for shape, dtype in pairs:
-            shp = []
-            for d in shape:
-                if d == -1:
-                    had_dynamic = True
-                    shp.append(DIM_SENTINEL)
-                else:
-                    shp.append(int(d))
+            shp = [sentinel if d == -1 else int(d) for d in shape]
             ss.append(jax.ShapeDtypeStruct(tuple(shp), types.np_dtype(dtype)))
         structs[slot] = ss
 
     if opdef.infer is not None:
-        result = opdef.infer(LoweringContext(attrs), structs)
+        ctx = LoweringContext(attrs)
+        ctx.dim_sentinel = sentinel
+        result = opdef.infer(ctx, structs)
     else:
         key = jax.random.key(0)
 
         def f(ins):
             ctx = LoweringContext(attrs, key=key)
+            ctx.dim_sentinel = sentinel
             return call_rule(opdef, ctx, ins)
 
         result = jax.eval_shape(f, structs)
+    return {slot: (list(vals) if isinstance(vals, (list, tuple)) else [vals])
+            for slot, vals in result.items()}
+
+
+# (op_type, attrs json, input signature) -> inferred result. Abstract
+# traces are pure functions of the key, and model builders repeat
+# identical layers (64 transformer blocks = 64x the same per-op shapes),
+# so memoizing collapses the build-time cost of the two-sentinel scheme.
+_infer_cache: Dict[tuple, Dict[str, List[tuple]]] = {}
+_MAX_INFER_CACHE = 4096
+
+
+def _infer_cache_key(op_type, attrs, ins_by_slot):
+    import json
+    try:
+        akey = json.dumps(attrs, sort_keys=True, default=repr)
+    except (TypeError, ValueError):  # unserializable attr -> don't cache
+        return None
+    sig = tuple(sorted((slot, tuple((tuple(s), str(d)) for s, d in pairs))
+                       for slot, pairs in ins_by_slot.items()))
+    return (op_type, akey, sig)
+
+
+def infer_op_shapes(op_type: str, attrs: Dict[str, Any],
+                    ins_by_slot: Dict[str, List[Any]]):
+    """Return {output_slot: [(shape, dtype), ...]} for an op given input
+    (shape, dtype) pairs. -1 dims are substituted with a sentinel and
+    traced through the lowering rule abstractly; a second trace with a
+    different sentinel identifies which output dims derive from the
+    dynamic inputs (those map back to -1)."""
+    opdef = get_op_def(op_type)
+    key = _infer_cache_key(op_type, attrs, ins_by_slot)
+    hit = _infer_cache.get(key) if key is not None else None
+    if hit is not None:
+        return {slot: list(pairs) for slot, pairs in hit.items()}
+    had_dynamic = any(d == -1 for pairs in ins_by_slot.values()
+                      for shape, _ in pairs for d in shape)
+    result = _eval_abstract(opdef, attrs, ins_by_slot, DIM_SENTINEL)
+    result_alt = (_eval_abstract(opdef, attrs, ins_by_slot, DIM_SENTINEL_ALT)
+                  if had_dynamic else None)
 
     out = {}
     for slot, vals in result.items():
-        vals = vals if isinstance(vals, (list, tuple)) else [vals]
-        out[slot] = [(_mark_dynamic(v.shape, had_dynamic), types.canonical_dtype(v.dtype))
-                     for v in vals]
+        alts = result_alt[slot] if result_alt is not None else [None] * len(vals)
+        out[slot] = [(_mark_dynamic(v.shape, a.shape if a is not None else None),
+                      types.canonical_dtype(v.dtype))
+                     for v, a in zip(vals, alts)]
+    if key is not None:
+        if len(_infer_cache) >= _MAX_INFER_CACHE:
+            _infer_cache.pop(next(iter(_infer_cache)))
+        _infer_cache[key] = {slot: list(pairs) for slot, pairs in out.items()}
     return out

@@ -27,6 +27,10 @@ _DEFS: Dict[str, tuple] = {
     # "" / "none" = compiler defaults; or an explicit comma-separated
     # k=v list (e.g. "xla_tpu_scoped_vmem_limit_kib=65536")
     "xla_compiler_options": ("auto", str),
+    # static program verification on Executor.prepare()/run() (analysis/):
+    # "error" rejects malformed programs before any XLA lowering, "warn"
+    # logs the diagnostics and proceeds, "off" (default) skips the sweep
+    "validate": ("off", str),
 }
 
 _FLAGS: Dict[str, Any] = {}
@@ -71,6 +75,7 @@ def get_flag(name: str):
 # dropout_impl=palas would otherwise silently select the default path)
 _CHOICES: Dict[str, tuple] = {
     "dropout_impl": ("auto", "pallas", "xla"),
+    "validate": ("error", "warn", "off"),
 }
 
 

@@ -121,3 +121,45 @@ class PruneForInferencePass(Pass):
             raise ValueError("prune_for_inference needs targets=[names]")
         names = [t.name if hasattr(t, "name") else str(t) for t in targets]
         return program._prune(names)
+
+
+@register_pass("verify")
+class VerifyPass(Pass):
+    """Whole-program static verification (analysis/): structural checks +
+    shape/dtype cross-check + TPU lints. Read-only by contract — it must
+    never bump the program version (a bump would recompile the next step
+    and invalidate prepared-executor handles for an inspection)."""
+
+    mutates = False
+
+    def apply(self, program, feed_targets=None, fetch_targets=None,
+              raise_on_error=True, collect=None, lint=True, **kw):
+        """`collect`: a caller-provided list the diagnostics are appended
+        to (the pass API returns the program, not findings). With
+        `raise_on_error` (default), ERROR findings raise
+        ProgramVerificationError."""
+        from . import analysis
+        diags = analysis.analyze_program(
+            program, feed_targets=feed_targets, fetch_targets=fetch_targets,
+            lint=lint)
+        if collect is not None:
+            collect.extend(diags)
+        if raise_on_error and analysis.has_errors(diags):
+            raise analysis.ProgramVerificationError(diags)
+        return program
+
+
+@register_pass("infer_shapes")
+class InferShapesPass(Pass):
+    """Whole-program shape/dtype propagation with write-back: fills
+    Variables whose build-time inference left an empty shape (reference:
+    the block-wide InferShape sweep, shape_inference.h:30). Mutates
+    declarations, so compiled caches are invalidated by the base-class
+    version bump."""
+
+    def apply(self, program, collect=None, **kw):
+        from .analysis import infer_program_shapes
+        _, diags = infer_program_shapes(program, update=True)
+        if collect is not None:
+            collect.extend(diags)
+        return program

@@ -84,18 +84,19 @@ def _shape(ctx, Input):
 
 def _reshape_infer(ctx, structs):
     """Exact static-shape rule. eval_shape can't be used here: the dynamic
-    batch dim is substituted with the (prime) DIM_SENTINEL, and a target
-    like [-1, K] would need SENTINEL % K == 0. With a dynamic input dim,
-    the -1 output dim is simply dynamic — runtime shapes are
-    authoritative."""
+    batch dim is substituted with a prime sentinel, and a target like
+    [-1, K] would need SENTINEL % K == 0. With a dynamic input dim, the -1
+    output dim is simply dynamic — runtime shapes are authoritative.
+    `ctx.dim_sentinel` is whichever sentinel THIS trace substituted
+    (infer_op_shapes runs two traces to classify dynamic dims)."""
     import math as _m
-    from ..core.registry import DIM_SENTINEL
 
+    sentinel = ctx.dim_sentinel
     X = structs["X"][0]
     target = [int(s) for s in ctx.attr("shape")]
     target = [int(X.shape[i]) if s == 0 else s
               for i, s in enumerate(target)]
-    dynamic_in = any(d >= DIM_SENTINEL and d % DIM_SENTINEL == 0
+    dynamic_in = any(d >= sentinel and d % sentinel == 0
                      for d in X.shape)
     if -1 in target:
         known = _m.prod(d for d in target if d != -1)
@@ -107,7 +108,7 @@ def _reshape_infer(ctx, structs):
             # (e.g. reshape([0, -1]) of a [-1, 4, 8] input -> (-1, 32))
             target[neg] = total // known
         elif dynamic_in:
-            target[neg] = DIM_SENTINEL
+            target[neg] = sentinel
         else:
             raise ValueError(
                 f"reshape: cannot infer -1 dim reshaping {tuple(X.shape)} "

@@ -46,6 +46,19 @@ OPTIMIZE_OP_TYPES = ("sgd", "momentum", "adam", "adamax", "adagrad",
                      "proximal_gd", "proximal_adagrad")
 
 
+def _verify_split(program: ir.Program, what: str):
+    """Static verification of a transpiler output (analysis/verifier):
+    these programs are GENERATED — a structural error here is a transpiler
+    bug surfacing as a tracer error hours into a distributed run
+    otherwise. Structural checks only (no shape sweep): split programs are
+    re-verified in full by Executor.prepare when `validate` is on."""
+    from ..analysis import (ProgramVerificationError, has_errors,
+                            verify_program)
+    diags = verify_program(program)
+    if has_errors(diags):
+        raise ProgramVerificationError(diags, context=what)
+
+
 class DistributeTranspilerConfig:
     """reference transpiler config: slice_var_up/min_block_size control how
     params were sliced across pservers; here they control when a parameter is
@@ -213,6 +226,7 @@ class DistributeTranspiler:
         program with optimizer ops stripped (updates run on the pservers);
         drive it with pserver.AsyncPSTrainer, which adds the host-side
         pull/push phases the reference expressed as send/recv ops."""
+        _verify_split(self._program, "trainer program")
         return self._program
 
     def get_pserver_program(self, endpoint) -> ir.Program:
@@ -239,6 +253,7 @@ class DistributeTranspiler:
         prog.global_block().append_op(
             "listen_and_serv",
             attrs={"endpoint": endpoint, "trainers": self._trainers})
+        _verify_split(prog, f"pserver program for {endpoint}")
         return prog
 
     def get_pserver_programs(self, endpoint):

@@ -1,0 +1,439 @@
+"""Static analysis layer (paddle_tpu/analysis): structural verifier,
+whole-program shape inference, diagnostics, the validate executor hook,
+the read-only verify pass, the paddle_lint CLI, and the registry
+satellites (register_grad error, two-sentinel dynamic-dim inference).
+
+The broken-program corpus here is the acceptance gate: every seeded
+defect class (undefined input, WAW, bad slot arity, shape mismatch,
+missing grad, ...) must be flagged with op provenance, and
+`Executor.prepare(validate="error")` must reject a malformed program
+before any XLA lowering."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as fluid
+from paddle_tpu import analysis, layers
+from paddle_tpu.analysis import Severity
+from paddle_tpu.core import registry
+from paddle_tpu.ir_pass import apply_pass
+
+
+def _codes(diags):
+    return [d.code for d in diags]
+
+
+def _one(diags, code):
+    hits = [d for d in diags if d.code == code]
+    assert hits, f"expected a {code!r} diagnostic, got {_codes(diags)}"
+    return hits[0]
+
+
+# ---------------------------------------------------------------------------
+# broken-program corpus: one program per seeded defect, golden diagnostics
+# ---------------------------------------------------------------------------
+
+def test_corpus_undefined_input():
+    p = fluid.Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(4, 8), dtype="float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["ghost"]}, outputs={"Out": ["y"]})
+    d = _one(analysis.analyze_program(p), "undefined-input")
+    assert d.severity == Severity.ERROR
+    assert "'ghost'" in d.message and d.op_type == "relu"
+    assert d.block_idx == 0 and d.op_idx == 0          # op provenance
+    # creation traceback points at THIS test file, not framework plumbing
+    assert d.site and "test_analysis.py" in d.site[0]
+
+
+def test_corpus_read_before_write():
+    p = fluid.Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(4, 8), dtype="float32", is_data=True)
+    b.create_var(name="t", shape=(4, 8), dtype="float32")  # declared, unwritten
+    b.append_op("elementwise_add", inputs={"X": ["x"], "Y": ["t"]},
+                outputs={"Out": ["y"]})
+    d = _one(analysis.analyze_program(p), "read-before-write")
+    assert "nothing wrote it" in d.message and d.var == "t"
+
+
+def test_corpus_write_after_write():
+    p = fluid.Program()
+    b = p.global_block()
+    b.create_var(name="t", shape=(2,), dtype="float32")
+    fill = {"shape": [2], "dtype": "float32", "value": 1.0}
+    b.append_op("fill_constant", outputs={"Out": ["t"]}, attrs=dict(fill))
+    b.append_op("fill_constant", outputs={"Out": ["t"]}, attrs=dict(fill))
+    d = _one(analysis.analyze_program(p), "write-after-write")
+    assert "op 0" in d.message and "dead" in d.message
+    assert d.op_idx == 1
+
+
+def test_corpus_waw_within_one_op():
+    p = fluid.Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(4,), dtype="float32", is_data=True)
+    b.append_op("batch_norm_stats_like", inputs={"X": ["x"]},
+                outputs={"MeanOut": ["m"], "VarOut": ["m"]})
+    diags = analysis.verify_program(p)
+    d = _one(diags, "write-after-write")
+    assert "two output slots" in d.message
+
+
+def test_waw_not_flagged_for_inplace_and_read_between():
+    """In-place updates (op reads what it writes) and rewrites after a
+    read are legal non-SSA patterns — optimizer ParamOut, increment."""
+    p = fluid.Program()
+    b = p.global_block()
+    b.create_var(name="c", shape=(1,), dtype="float32", persistable=True)
+    b.append_op("increment", inputs={"X": ["c"]}, outputs={"Out": ["c"]},
+                attrs={"step": 1.0})
+    b.append_op("increment", inputs={"X": ["c"]}, outputs={"Out": ["c"]},
+                attrs={"step": 1.0})
+    assert "write-after-write" not in _codes(analysis.verify_program(p))
+
+
+def test_corpus_bad_slot_arity():
+    p = fluid.Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(4, 8), dtype="float32", is_data=True)
+    b.append_op("mul", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    d = _one(analysis.analyze_program(p), "missing-slot")
+    assert "'Y'" in d.message and d.op_type == "mul"
+
+
+def test_corpus_unknown_slot_is_warning():
+    p = fluid.Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(4,), dtype="float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["x"], "Ghost": ["x"]},
+                outputs={"Out": ["y"]})
+    d = _one(analysis.verify_program(p), "unknown-slot")
+    assert d.severity == Severity.WARNING
+    assert "silently ignored" in d.message
+
+
+def test_corpus_unknown_op_suggests_close_names():
+    p = fluid.Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(4,), dtype="float32", is_data=True)
+    b.append_op("reluu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    d = _one(analysis.analyze_program(p), "unknown-op")
+    assert "relu" in d.message and "did you mean" in d.message
+
+
+def test_corpus_shape_mismatch():
+    p = fluid.Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(-1, 8), dtype="float32", is_data=True)
+    b.create_var(name="y", shape=(-1, 99), dtype="float32")
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    d = _one(analysis.analyze_program(p), "shape-mismatch")
+    assert "(-1, 99)" in d.message and "(-1, 8)" in d.message
+
+
+def test_corpus_dtype_mismatch():
+    p = fluid.Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(4, 8), dtype="float32", is_data=True)
+    b.create_var(name="y", shape=(4, 8), dtype="int32")
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    _one(analysis.analyze_program(p), "dtype-mismatch")
+
+
+def test_corpus_missing_grad():
+    p = fluid.Program()
+    b = p.global_block()
+    b.create_parameter("w", (8, 4), "float32")
+    b.create_var(name="lr", shape=(1,), dtype="float32", persistable=True)
+    b.create_var(name="w@GRAD", shape=(8, 4), dtype="float32")
+    b.append_op("sgd", inputs={"Param": ["w"], "Grad": ["w@GRAD"],
+                               "LearningRate": ["lr"]},
+                outputs={"ParamOut": ["w"]})
+    d = _one(analysis.verify_program(p), "missing-grad")
+    assert "'w'" in d.message and "'w@GRAD'" in d.message
+
+
+def test_corpus_bad_sub_block():
+    p = fluid.Program()
+    p.global_block().append_op(
+        "while", outputs={"Out": ["o"]},
+        attrs={"sub_block": 99, "carry_vars": [], "cond_var": "c"})
+    d = _one(analysis.verify_program(p), "bad-sub-block")
+    assert "99" in d.message
+
+
+def test_corpus_feed_fetch_targets():
+    p = fluid.Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(4,), dtype="float32", is_data=True)
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    diags = analysis.verify_program(p, feed_targets=["nope"],
+                                    fetch_targets=["ghost"])
+    _one(diags, "bad-feed-target")
+    _one(diags, "bad-fetch-target")
+    # an undeclared-but-produced name is a VALID fetch target (env-based)
+    clean = analysis.verify_program(p, feed_targets=["x"],
+                                    fetch_targets=["y"])
+    assert not clean, _codes(clean)
+
+
+def test_lint_float64_and_dead_op():
+    p = fluid.Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(4,), dtype="float64", is_data=True)
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    b.append_op("tanh", inputs={"X": ["x"]}, outputs={"Out": ["z"]})
+    diags = analysis.lint_program(p, fetch_targets=["y"])
+    assert _one(diags, "float64-on-tpu").severity == Severity.WARNING
+    dead = _one(diags, "dead-op")
+    assert dead.op_type == "tanh" and dead.op_idx == 1
+
+
+def test_lint_feed_shape_hazard_severities():
+    p = fluid.Program()
+    b = p.global_block()
+    # leading batch+time run of -1s: the padded-sequence contract -> INFO
+    b.create_var(name="seqish", shape=(-1, -1, 1), dtype="int64",
+                 is_data=True)
+    # -1 AFTER a concrete dim: no contract, recompiles per batch -> WARNING
+    b.create_var(name="odd", shape=(-1, 784, -1), dtype="float32",
+                 is_data=True)
+    diags = analysis.lint_program(p)
+    sev = {d.var: d.severity for d in diags
+           if d.code == "feed-shape-recompile"}
+    assert sev == {"seqish": Severity.INFO, "odd": Severity.WARNING}
+
+
+def test_diagnostics_rank_most_severe_first():
+    p = fluid.Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(4,), dtype="float64", is_data=True)
+    b.append_op("relu", inputs={"X": ["ghost"]}, outputs={"Out": ["y"]})
+    diags = analysis.analyze_program(p)
+    sevs = [d.severity for d in diags]
+    assert sevs == sorted(sevs, reverse=True)
+    assert diags[0].severity == Severity.ERROR
+
+
+# ---------------------------------------------------------------------------
+# executor hook: validate=error|warn|off before any lowering
+# ---------------------------------------------------------------------------
+
+def _malformed_program():
+    p = fluid.Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(4, 8), dtype="float32", is_data=True)
+    b.append_op("mul", inputs={"X": ["x"], "Y": ["ghost"]},
+                outputs={"Out": ["y"]})
+    return p
+
+
+def test_prepare_validate_error_rejects_before_lowering():
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(fluid.ProgramVerificationError) as ei:
+        exe.prepare(_malformed_program(), fetch_list=["y"],
+                    validate="error")
+    assert "undefined-input" in str(ei.value)
+    assert "ghost" in str(ei.value)
+
+
+def test_run_validate_flag_rejects_and_off_is_default():
+    exe = fluid.Executor(fluid.CPUPlace())
+    fluid.set_flag("validate", "error")
+    try:
+        with pytest.raises(fluid.ProgramVerificationError):
+            exe.run(_malformed_program(), feed={"x": np.zeros((4, 8), np.float32)},
+                    fetch_list=["y"])
+    finally:
+        fluid.set_flag("validate", "off")
+
+
+def test_validate_warn_still_runs():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        y = layers.fc(input=x, size=4, act="relu")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    h = exe.prepare(main, fetch_list=[y.name], validate="warn")
+    out, = h.run({"x": np.ones((2, 8), np.float32)})
+    assert out.shape == (2, 4)
+
+
+def test_validate_bad_mode_raises():
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(ValueError, match="validate"):
+        exe.prepare(fluid.Program(), validate="nope")
+
+
+# ---------------------------------------------------------------------------
+# read-only verify pass: must not invalidate PR-1 prepared-executor caches
+# ---------------------------------------------------------------------------
+
+def _trained_lenet():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        y = layers.fc(input=x, size=4, act="relu")
+        loss = layers.mean(y)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_verify_pass_is_read_only():
+    main, startup, loss = _trained_lenet()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": np.ones((2, 8), np.float32)}
+    exe.run(main, feed=feed, fetch_list=[loss])
+    v0 = main._version
+    n_compiled = len(exe._cache)
+    n_prepared = len(exe._prepared)
+    apply_pass("verify", main, fetch_targets=[loss.name])
+    assert main._version == v0          # no bump: prepared handles stay valid
+    exe.run(main, feed=feed, fetch_list=[loss])
+    assert len(exe._cache) == n_compiled      # no recompile
+    assert len(exe._prepared) == n_prepared   # same memoized handle
+
+
+def test_verify_pass_raises_and_collects():
+    with pytest.raises(fluid.ProgramVerificationError):
+        apply_pass("verify", _malformed_program())
+    found = []
+    apply_pass("verify", _malformed_program(), raise_on_error=False,
+               collect=found)
+    assert "undefined-input" in [d.code for d in found]
+
+
+def test_infer_shapes_pass_fills_gaps_and_bumps():
+    p = fluid.Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(-1, 8), dtype="float32", is_data=True)
+    b.create_var(name="y", shape=(), dtype="float32")   # unshaped temp
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    v0 = p._version
+    apply_pass("infer_shapes", p)
+    assert b.vars["y"].shape == (-1, 8)
+    assert p._version > v0              # mutating pass DOES bump
+
+
+# ---------------------------------------------------------------------------
+# transpiler split verification
+# ---------------------------------------------------------------------------
+
+def test_transpiler_outputs_verify():
+    main, startup, loss = _trained_lenet()
+    with fluid.program_guard(main, startup):
+        t = fluid.DistributeTranspiler()
+        t.transpile(trainer_id=0, program=main,
+                    pservers="127.0.0.1:0", trainers=1, sync_mode=False)
+        trainer = t.get_trainer_program()
+        pserver = t.get_pserver_program("127.0.0.1:0")
+    assert not analysis.has_errors(analysis.verify_program(trainer))
+    assert pserver.global_block().ops[0].type == "listen_and_serv"
+
+
+# ---------------------------------------------------------------------------
+# satellites: register_grad error + two-sentinel dynamic-dim inference
+# ---------------------------------------------------------------------------
+
+def test_register_grad_unregistered_forward_names_op():
+    with pytest.raises(ValueError) as ei:
+        @registry.register_grad("reluu")
+        def _g(ctx, ins, out_grads):
+            pass
+    msg = str(ei.value)
+    assert "reluu" in msg and "not registered" in msg
+    assert "closest registered" in msg and "relu" in msg.split(
+        "closest registered")[1]  # close-name suggestion
+
+
+def test_infer_shapes_mixed_static_dynamic_concat():
+    """Regression: concat of a dynamic and a static tensor used to leave
+    the bogus concrete extent SENTINEL+k (e.g. 8194) because the sum is
+    not divisible by the sentinel; the two-sentinel trace classifies it
+    as dynamic."""
+    out = registry.infer_op_shapes(
+        "concat", {"axis": 0},
+        {"X": [((-1, 4), "float32"), ((3, 4), "float32")]})
+    assert out["Out"][0][0] == (-1, 4)
+
+
+def test_infer_shapes_static_dims_survive_dynamic_inputs():
+    """A big static dim (>= the sentinel) next to a dynamic batch must
+    NOT be reclassified as dynamic (old risk of the >=-and-divisible
+    heuristic), and multiples of the batch must be."""
+    out = registry.infer_op_shapes(
+        "relu", {}, {"X": [((-1, 30000), "float32")]})
+    assert out["Out"][0][0] == (-1, 30000)
+    out = registry.infer_op_shapes(
+        "concat", {"axis": 0},
+        {"X": [((-1, 4), "float32"), ((-1, 4), "float32")]})
+    assert out["Out"][0][0] == (-1, 4)
+
+
+def test_infer_shapes_reshape_under_both_sentinels():
+    # -1 target absorbing the dynamic batch stays dynamic
+    out = registry.infer_op_shapes(
+        "reshape", {"shape": [-1, 32]},
+        {"X": [((-1, 4, 8), "float32")]})
+    assert out["Out"][0][0] == (-1, 32)
+    # -1 target NOT absorbing the batch resolves exactly
+    out = registry.infer_op_shapes(
+        "reshape", {"shape": [0, -1]},
+        {"X": [((-1, 4, 8), "float32")]})
+    assert out["Out"][0][0] == (-1, 32)
+
+
+def test_all_static_inference_single_trace():
+    out = registry.infer_op_shapes(
+        "mul", {}, {"X": [((4, 8), "float32")], "Y": [((8, 3), "float32")]})
+    assert out["Out"][0] == ((4, 3), "float32")
+
+
+# ---------------------------------------------------------------------------
+# paddle_lint CLI (in-process: subprocess startup costs ~15s of jax import)
+# ---------------------------------------------------------------------------
+
+def test_cli_flags_broken_program(tmp_path, capsys):
+    from tools.paddle_lint import main as lint_main
+    path = tmp_path / "broken.json"
+    path.write_text(_malformed_program().serialize_to_string())
+    rc = lint_main([str(path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "undefined-input" in out and "ghost" in out
+
+
+def test_cli_json_format_and_strict(tmp_path, capsys):
+    from tools.paddle_lint import main as lint_main
+    import json as _json
+    p = fluid.Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=(4,), dtype="float64", is_data=True)
+    b.append_op("relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]})
+    path = tmp_path / "warny.json"
+    path.write_text(p.serialize_to_string())
+    assert lint_main([str(path), "--format", "json"]) == 0  # warnings pass
+    report = _json.loads(capsys.readouterr().out)
+    assert report["errors"] == 0 and report["warnings"] >= 1
+    assert any(d["code"] == "float64-on-tpu"
+               for d in report["diagnostics"])
+    assert lint_main([str(path)]) == 0
+    capsys.readouterr()
+    assert lint_main([str(path), "--strict"]) == 1
+
+
+def test_cli_model_mode(capsys):
+    from tools.paddle_lint import main as lint_main
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        rc = lint_main(["--model", "mnist"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "0 error(s)" in out
