@@ -19,6 +19,7 @@ long-context pair at seq 2048 where the Pallas flash path wins.
 import json
 import os
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -358,21 +359,68 @@ _PARTIAL = {"value": 0.0, "extra": {}}
 _DONE = None  # threading.Event, set when main() prints normally
 
 
-def _emit_partial_and_exit():
-    _PARTIAL["extra"]["bench_watchdog"] = (
-        "global watchdog fired: a segment hung in a native call (dead "
-        "tunnel?); metrics below were measured before the hang, the "
-        "rest are absent")
-    print(json.dumps({
-        "metric": "resnet50_train_images_per_sec_per_chip",
-        "value": _PARTIAL["value"],
-        "unit": "images/sec",
-        "vs_baseline": round(_PARTIAL["value"] / BASELINE_IMG_PER_SEC, 2),
-        "extra": _PARTIAL["extra"],
-    }))
-    sys.stdout.flush()
-    sys.stderr.flush()
-    os._exit(1)
+_EMIT_ONCE = threading.Lock()
+
+
+def _emit_partial_and_exit(reason=None):
+    """Emit a WELL-FORMED (partial) JSON record and hard-exit: the driver
+    must never be left with only a raw log tail (BENCH_r05 recorded
+    rc=124 with no JSON at all). `failure_stage` names the segment that
+    was running when the run died; `segment_wall_s` has the per-segment
+    wall timings measured so far.
+
+    Exactly-once: SIGTERM can reach both the Python-level handler (main
+    thread) and the wakeup-fd watcher thread — only the first caller
+    emits, later callers park until its os._exit tears the process down
+    (two interleaved JSON lines would be worse than none)."""
+    if not _EMIT_ONCE.acquire(blocking=False):
+        while True:
+            time.sleep(60)
+    # everything below runs under try/finally: whatever goes wrong, the
+    # process MUST still exit promptly (a dead emitter holding the lock
+    # would recreate the lingering-process failure this code fixes)
+    try:
+        _PARTIAL["extra"]["bench_failure"] = reason or (
+            "global watchdog fired: a segment hung in a native call "
+            "(dead tunnel?); metrics below were measured before the "
+            "hang, the rest are absent")
+        # the main thread may still be mutating _PARTIAL["extra"]
+        # (note(), per-segment bookkeeping) while this thread serializes
+        # it — retry the dump (any error: concurrent-mutation
+        # RuntimeError, a non-JSON value, ...), then degrade to the
+        # failure reason alone rather than emit NOTHING
+        line = None
+        for attempt in range(5):
+            try:
+                line = json.dumps({
+                    "metric": "resnet50_train_images_per_sec_per_chip",
+                    "value": float(_PARTIAL["value"]),
+                    "unit": "images/sec",
+                    "vs_baseline": round(
+                        float(_PARTIAL["value"]) / BASELINE_IMG_PER_SEC,
+                        2),
+                    "extra": _PARTIAL["extra"],
+                }, default=str)
+                break
+            except Exception:
+                time.sleep(0.05)
+        if line is None:
+            line = json.dumps({
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": 0.0,
+                "unit": "images/sec",
+                "vs_baseline": 0.0,
+                "extra": {
+                    "bench_failure": str(_PARTIAL["extra"].get(
+                        "bench_failure")),
+                    "failure_stage": str(_PARTIAL["extra"].get(
+                        "failure_stage"))},
+            })
+        print(line)
+        sys.stdout.flush()
+        sys.stderr.flush()
+    finally:
+        os._exit(1)
 
 
 def main():
@@ -405,6 +453,61 @@ def main():
     threading.Thread(target=_watchdog, daemon=True,
                      name="bench-watchdog").start()
 
+    # a driver-side `timeout` sends SIGTERM before SIGKILL: emit the
+    # partial record NOW instead of dying with only a log tail
+    # (BENCH_r05 rc=124 was exactly this, undiagnosable from the JSON)
+    def _term_reason():
+        return (f"terminated by SIGTERM (driver timeout?) during stage "
+                f"{_PARTIAL['extra'].get('failure_stage')!r}; metrics "
+                f"below were measured before the kill")
+
+    def _on_term(signum, frame):
+        _emit_partial_and_exit(_term_reason())
+
+    signal.signal(signal.SIGTERM, _on_term)
+    # Python-level handlers only run on the MAIN thread between bytecodes
+    # — a main thread wedged inside a native PJRT/compile call (the
+    # rc=124 case) never executes them. set_wakeup_fd delivers the signal
+    # byte from the C handler regardless, so a watcher thread can emit
+    # the partial JSON even during a native hang.
+    _sig_r, _sig_w = os.pipe()
+    os.set_blocking(_sig_w, False)
+    signal.set_wakeup_fd(_sig_w, warn_on_full_buffer=False)
+
+    def _term_watcher():
+        while True:
+            try:
+                data = os.read(_sig_r, 1)
+            except OSError:
+                return
+            if not data:
+                return
+            # SIGALRM bytes from the per-segment hang-breakers drain
+            # through here too — only TERM triggers the emission
+            if data[0] == signal.SIGTERM:
+                _emit_partial_and_exit(_term_reason())
+
+    threading.Thread(target=_term_watcher, daemon=True,
+                     name="bench-sigterm-watcher").start()
+
+    # fluid-scope telemetry for the whole run: per-segment step-phase
+    # breakdowns + recompile counts land next to each headline number
+    # (the per-step overhead is nanoseconds against ms-scale steps)
+    import paddle_tpu.observe as _obs
+    fluid.set_flag("observe", True)
+
+    def _recompile_counts():
+        """Per-cause compile counts from the CUMULATIVE metrics counter
+        (the observatory's event ring is bounded at 256 — counts derived
+        from it would go backwards once old events fall off)."""
+        c = _obs.default_registry().get("executor_recompiles_total")
+        out = {}
+        if c is not None:
+            for labels, v in c.items():
+                cause = labels.get("cause", "unknown")
+                out[cause] = out.get(cause, 0) + v
+        return out
+
     def note(**kv):
         _PARTIAL["extra"].update(kv)
 
@@ -421,6 +524,11 @@ def main():
         def _alarm(signum, frame):
             raise TimeoutError(f"segment exceeded {timeout_s}s")
 
+        # failure_stage: whatever stage is current when the process dies
+        # (watchdog/SIGTERM emission) or fails softly is named in the
+        # recorded JSON — the rc=124 diagnosability fix
+        _PARTIAL["extra"]["failure_stage"] = label
+        t_seg = time.perf_counter()
         prev = signal.signal(signal.SIGALRM, _alarm)
         signal.alarm(timeout_s)
         try:
@@ -428,8 +536,31 @@ def main():
         except Exception as e:
             print(f"WARNING: bench segment {label!r} failed ({e!r}); "
                   f"recording sentinel", file=sys.stderr)
+            _PARTIAL["extra"].setdefault("failed_stages", []).append(label)
             return default
         finally:
+            _PARTIAL["extra"].setdefault("segment_wall_s", {})[label] = \
+                round(time.perf_counter() - t_seg, 2)
+            # per-segment telemetry: step-phase breakdown + recompile
+            # deltas from fluid-scope (reset per segment so each headline
+            # number carries ITS phase profile and compile count)
+            try:
+                ph = _obs.get_steplog().phase_summary(reset=True)
+                if ph.get("steps"):
+                    _PARTIAL["extra"].setdefault("step_phases_us", {})[
+                        label] = dict(ph["phase_us"],
+                                      steps=ph["steps"],
+                                      mean_step_us=ph["mean_step_us"])
+                counts = _recompile_counts()
+                prevc = seg._recompiles_seen
+                delta = {c: n - prevc.get(c, 0) for c, n in counts.items()
+                         if n - prevc.get(c, 0) > 0}
+                seg._recompiles_seen = counts
+                if delta:
+                    _PARTIAL["extra"].setdefault("recompiles", {})[
+                        label] = delta
+            except Exception:
+                pass
             # re-arm a short breaker over the cleanup too: _release talks
             # to the device and can itself hang on a dead tunnel
             signal.alarm(120)
@@ -440,14 +571,21 @@ def main():
             signal.alarm(0)
             signal.signal(signal.SIGALRM, prev)
 
+    seg._recompiles_seen = {}
+
+    _PARTIAL["extra"]["failure_stage"] = "peak_probe"
     try:
         peak = measure_peak_tflops(jax) * 1e12
     except Exception as e:
         # MFU needs SOME denominator; the measured envelope across
         # recorded rounds is 191.5-194, its midpoint is the least-wrong
         # stand-in and the warning makes the substitution visible
+        # (backend-unavailable lands here: the stage is recorded so the
+        # JSON says WHERE the backend died, not just that it did)
         print(f"WARNING: peak probe failed ({e!r}); using the recorded "
               f"envelope midpoint 192.6 TFLOP/s", file=sys.stderr)
+        _PARTIAL["extra"].setdefault("failed_stages", []).append(
+            "peak_probe")
         peak = 192.6e12
     note(measured_peak_tflops_bf16=round(peak / 1e12, 1))
 
@@ -514,11 +652,13 @@ def main():
                                   warmup=3), (0.0, 0.0))
     note(transformer_seq4096_flash_tokens_per_sec=round(tok_4k_fus, 0),
          transformer_seq4096_unfused_tokens_per_sec=round(tok_4k_unf, 0))
+    _PARTIAL["extra"]["failure_stage"] = "feeder_overlap_subprocess"
     feeder = feeder_overlap_subprocess()
     lstm_tok, lstm_ex = seg(
         "stacked_lstm",
         lambda: bench_stacked_lstm(fluid, models, jax), (0.0, 0.0))
     note(stacked_lstm_examples_per_sec=round(lstm_ex, 1))
+    _PARTIAL["extra"]["failure_stage"] = "step_overhead_subprocess"
     overhead = step_overhead_subprocess()
     note(step_overhead_us=overhead.get("step_overhead_us", 0.0),
          step_overhead_us_unprepared=overhead.get(
@@ -561,6 +701,7 @@ def main():
         ips, rn_fps = ips2, rn_fps2
     _PARTIAL["value"] = round(ips, 2)   # keep the partial record adopted
     note(resnet50_mfu=round(rn_fps / peak, 3))
+    _PARTIAL["extra"]["failure_stage"] = "tpu_gated_tests"
     gated = tpu_gated_tests()
 
     extra = {
@@ -601,6 +742,16 @@ def main():
         "resnet50_mfu_remeasure": round(rn_fps2 / peak, 3),
         "tpu_gated_tests": gated,
     }
+    # normal completion: no stage is "failing"; soft failures (sentinel
+    # segments) stay listed in failed_stages. Carry over the per-segment
+    # telemetry accumulated in _PARTIAL plus the whole-run compile story.
+    extra["failure_stage"] = (_PARTIAL["extra"].get("failed_stages")
+                              or [None])[0]
+    for k in ("failed_stages", "segment_wall_s", "step_phases_us",
+              "recompiles"):
+        if k in _PARTIAL["extra"]:
+            extra[k] = _PARTIAL["extra"][k]
+    extra["recompile_causes_total"] = _recompile_counts()
     drift = check_claims(extra)
     if drift:
         extra["claim_drift"] = drift

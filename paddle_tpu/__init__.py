@@ -65,6 +65,7 @@ from .lod_tensor import create_lod_tensor, create_random_int_lodtensor  # noqa: 
 from .enforce import EnforceNotMet  # noqa: F401
 from . import flags  # noqa: F401
 from .flags import get_flag, set_flag  # noqa: F401
+from . import observe  # noqa: F401  (fluid-scope runtime telemetry)
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
 from .async_feeder import AsyncFeeder  # noqa: F401

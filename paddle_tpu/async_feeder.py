@@ -16,9 +16,13 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Dict, Iterable, Optional
 
 import jax
+
+from . import flags as _flags
+from .observe import metrics as _metrics
 
 
 class AsyncFeeder:
@@ -103,7 +107,34 @@ class AsyncFeeder:
         t.start()
         try:
             while True:
-                item = q.get()
+                if _flags.get_flag("observe"):
+                    # queue-depth/starvation gauges: a consumer wait with
+                    # an empty queue means the producer (reader + host
+                    # conversion) is the bottleneck — the overlap the
+                    # feeder exists to provide is NOT happening
+                    t0 = time.perf_counter()
+                    starved = q.empty()
+                    item = q.get()
+                    wait = time.perf_counter() - t0
+                    _metrics.gauge(
+                        "feeder_queue_depth",
+                        "batches buffered ahead of the consumer").set(
+                            q.qsize())
+                    if item is not end:
+                        _metrics.counter(
+                            "feeder_batches_total",
+                            "batches delivered to the consumer").inc()
+                        _metrics.histogram(
+                            "feeder_consumer_wait_seconds",
+                            "time the consumer blocked waiting for a batch"
+                        ).observe(wait)
+                        if starved:
+                            _metrics.counter(
+                                "feeder_starvation_total",
+                                "consumer arrivals that found the queue "
+                                "empty (producer-bound pipeline)").inc()
+                else:
+                    item = q.get()
                 if item is end:
                     break
                 yield self._place(item)

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import itertools
 import logging
+import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import jax
@@ -27,6 +28,7 @@ import numpy as np
 
 from . import ir, registry
 from .. import flags as _flags
+from ..observe import steplog as _steplog
 from .lowering import BlockLowerer
 
 logger = logging.getLogger(__name__)
@@ -557,6 +559,10 @@ class PreparedProgram:
             raise RuntimeError(
                 "program was mutated after prepare(); prepare() it again "
                 "(Executor.run() re-prepares automatically)")
+        # telemetry gate: ONE flag read + branch when off — the prepared
+        # fast path performs zero registry writes unless observing
+        obs_on = _flags.get_flag("observe")
+        t0 = time.perf_counter() if obs_on else 0.0
         feed = feed or {}
         # py_reader-fed program: no feed -> pop the next queued batch
         # (raises EOFException at end of pass, reference read-op contract)
@@ -586,11 +592,23 @@ class PreparedProgram:
                     feed_arrays[name] = arr
         else:
             feed_arrays = _convert_feed_dict(self._block, feed)
+        if obs_on:
+            t_fc = time.perf_counter()  # end of feed conversion proper
         entry = self._entry
+        bound = False
         if entry is None or feed_arrays.keys() != self._entry_keys:
             entry = self._bind(feed, feed_arrays)
+            bound = True
+        if obs_on:
+            # feed_shape observatory: a new shape/dtype signature on a
+            # bound entry means jax.jit retraces + XLA recompiles
+            _steplog.track_shapes(entry, program._uid, feed_arrays,
+                                  source="executor")
+            t1 = time.perf_counter()
         counter = self._exe._count_run(program._uid)
         mut, const = self._state.get(entry, self.scope)
+        if obs_on:
+            t2 = time.perf_counter()
         if self._use_device_ctx:
             with jax.default_device(self._device):
                 fetches, new_state = entry.run_with_state(
@@ -598,9 +616,35 @@ class PreparedProgram:
         else:
             fetches, new_state = entry.run_with_state(
                 self.scope, feed_arrays, mut, const, counter)
+        if obs_on:
+            t3 = time.perf_counter()
         self._state.commit(entry, self.scope, new_state)
+        if obs_on:
+            t4 = time.perf_counter()
         if return_numpy:
             fetches = [np.asarray(f) for f in fetches]
+        if obs_on:
+            t5 = time.perf_counter()
+            # device_compute is the run_with_state wall: jitted dispatch +
+            # (under sync dispatch) device time + the in-call scope update
+            # (first call also traces + XLA-compiles inside it);
+            # write_back is the state-cache commit; fetch is the host
+            # transfer np.asarray forces (zero when return_numpy=False —
+            # the async-dispatch overlap the fast path is built on).
+            # Binding (validation, feed plan, cache lookup) is recorded as
+            # its own one-shot `bind` phase so it never pollutes the
+            # steady-state feed_convert numbers.
+            phases = {
+                "feed_convert": t_fc - t0,
+                "state_gather": t2 - t1,
+                "device_compute": t3 - t2,
+                "write_back": t4 - t3,
+                "fetch": t5 - t4,
+            }
+            if bound:
+                phases["bind"] = t1 - t_fc
+            _steplog.get_steplog().record(_steplog.StepStats(
+                program._uid, "executor", time.time(), phases))
         return fetches
 
     def _build_feed_plan(self, feed):
@@ -635,6 +679,14 @@ class PreparedProgram:
                          program.random_seed)  # seed is baked into the trace
             entry = exe._cache.get(cache_key)
             if entry is None:
+                # recompilation observatory: a compile-cache miss means a
+                # new XLA executable — record it with its attributed cause
+                # (first_call / program_version / copts_change / ...)
+                _steplog.observatory().note_entry_build(
+                    program._uid, program._version, sig,
+                    tuple(self.fetch_names),
+                    tuple(sorted(copts.items())) if copts else None,
+                    source="executor", scope_uid=self.scope._uid)
                 stream = exe._stream_for(program._uid)
                 with jax.default_device(self._device):
                     entry = _CompiledProgram(
@@ -812,6 +864,9 @@ class Executor:
         feed_arrays = _convert_feed_dict(block, feed)
         copts = resolve_compiler_options(self.place.jax_device().platform,
                                          program)
+        # deliberate cache bypass: recorded as its own cause, without
+        # polluting the observatory's attribution state for cached runs
+        _steplog.observatory().record(program._uid, "uncached", "executor")
         stream = self._stream_for(program._uid)
         with jax.default_device(self.place.jax_device()):
             compiled = _CompiledProgram(program, sorted(feed_arrays),

@@ -31,6 +31,11 @@ _DEFS: Dict[str, tuple] = {
     # "error" rejects malformed programs before any XLA lowering, "warn"
     # logs the diagnostics and proceeds, "off" (default) skips the sweep
     "validate": ("off", str),
+    # runtime telemetry (observe/): per-step phase timings, feeder queue
+    # gauges, pserver RPC counters, recompile-cause metrics. Off (default)
+    # keeps the prepared fast path free of registry writes; compile-time
+    # recompile events are recorded regardless (they are never hot)
+    "observe": (False, bool),
 }
 
 _FLAGS: Dict[str, Any] = {}

@@ -1,0 +1,64 @@
+"""fluid-scope: unified runtime telemetry for paddle_tpu.
+
+Three cooperating pieces (see docs/OBSERVABILITY.md):
+
+- `observe.metrics`  — process-wide registry of counters / gauges /
+  histograms (thread-safe, labeled, snapshot/JSON/Prometheus export)
+- `observe.tracer`   — structured spans in a bounded ring buffer with
+  chrome://tracing export; absorbs the profiler's host-event table
+- `observe.steplog`  — per-run() StepStats phase timings + the
+  recompilation observatory (every jit cache miss, with attributed cause)
+
+Emission from hot paths (Executor/PreparedProgram/ParallelExecutor steps,
+AsyncFeeder, pserver RPC) is gated on the `observe` flag:
+
+    fluid.set_flag("observe", True)        # or PADDLE_TPU_OBSERVE=1
+
+With the flag off, the prepared-program fast path performs ZERO registry
+writes per step (one flag read + branch only). Compile-time recompile
+events are recorded regardless — they are never hot and they are what
+`tools/telemetry_dump.py --assert-no-recompiles` audits in CI.
+"""
+
+from __future__ import annotations
+
+from .. import flags as _flags
+from . import metrics, steplog, tracer  # noqa: F401
+from .metrics import counter, default_registry, gauge, histogram  # noqa: F401
+from .steplog import (StepStats, get_steplog, observatory,  # noqa: F401
+                      track_shapes)
+from .tracer import get_tracer  # noqa: F401
+
+
+def enabled() -> bool:
+    """The hot-path gate: one flag-registry read."""
+    return _flags.get_flag("observe")
+
+
+def enable():
+    _flags.set_flag("observe", True)
+
+
+def disable():
+    _flags.set_flag("observe", False)
+
+
+def summary() -> dict:
+    """One dict with everything a run left behind — what
+    tools/telemetry_dump.py prints and bench.py records."""
+    return {
+        "metrics": default_registry().snapshot(),
+        "steps": get_steplog().phase_summary(),
+        "recompiles": {
+            "counts": observatory().counts(),
+            "events": [e.as_dict() for e in observatory().events()],
+        },
+    }
+
+
+def reset():
+    """Clear every telemetry store (tests / between bench segments)."""
+    default_registry().reset()
+    get_tracer().clear()
+    get_steplog().clear()
+    observatory().clear()

@@ -1,0 +1,279 @@
+"""Process-wide metrics registry: counters / gauges / histograms.
+
+Reference analog: the profiler event tables of platform/profiler.cc gave
+Fluid aggregate counts; TensorFlow's whitepaper credits built-in metrics
+plumbing for making large-scale training debuggable. Here the registry is
+a plain thread-safe in-process store — no exporter daemon, no deps — with
+`snapshot()` (dict), `to_json()` and `to_prometheus()` (text exposition
+format) so a training loop, bench.py, or tools/telemetry_dump.py can dump
+it at any point.
+
+All three metric kinds support labels passed as keyword arguments:
+
+    counter("pserver_client_requests_total").inc(cmd="push_grad")
+    histogram("executor_step_phase_us").observe(12.5, phase="feed_convert")
+
+Writers are cheap (one lock + dict update) but NOT free: runtime emitters
+gate on the `observe` flag so the prepared-executor hot path stays clean
+when telemetry is off.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+# wide geometric default buckets: usable for µs phase timings and for
+# second-scale RPC latencies alike (callers pick the unit, the buckets
+# span 1e-6 .. 1e6)
+DEFAULT_BUCKETS = (1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0, 10.0, 100.0,
+                   1e3, 1e4, 1e5, 1e6)
+
+
+def _label_key(labels: Dict[str, Any]) -> Tuple:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _label_str(key: Tuple) -> str:
+    return ",".join(f"{k}={v}" for k, v in key)
+
+
+def _prom_escape(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _prom_labels(key: Tuple, extra: str = "") -> str:
+    parts = [f'{k}="{_prom_escape(v)}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple, Any] = {}
+
+    def clear(self):
+        with self._lock:
+            self._values.clear()
+
+    def labelsets(self):
+        with self._lock:
+            return list(self._values)
+
+    def items(self):
+        """[(labels_dict, value)] over every label set. For histograms
+        the value is the internal bucket state — use summary() there."""
+        with self._lock:
+            return [(dict(k), v) for k, v in self._values.items()]
+
+    # scalar (counter/gauge) serialization; Histogram overrides both
+    def _snapshot(self):
+        with self._lock:
+            return {_label_str(k): v for k, v in self._values.items()}
+
+    def _prometheus(self, lines):
+        with self._lock:
+            for k, v in sorted(self._values.items()):
+                lines.append(f"{self.name}{_prom_labels(k)} {v}")
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, n: float = 1, **labels):
+        k = _label_key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0) + n
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0)
+
+    def total(self) -> float:
+        """Sum over every label set."""
+        with self._lock:
+            return sum(self._values.values())
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, v: float, **labels):
+        with self._lock:
+            self._values[_label_key(labels)] = v
+
+    def inc(self, n: float = 1, **labels):
+        k = _label_key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0) + n
+
+    def dec(self, n: float = 1, **labels):
+        self.inc(-n, **labels)
+
+    def value(self, **labels) -> Optional[float]:
+        with self._lock:
+            return self._values.get(_label_key(labels))
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram. Per label set it keeps cumulative bucket
+    counts plus sum/count/min/max, so `summary()` can report a mean and
+    envelope without storing samples."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "", buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help)
+        self.buckets = tuple(sorted(buckets))
+
+    def observe(self, v: float, **labels):
+        k = _label_key(labels)
+        with self._lock:
+            st = self._values.get(k)
+            if st is None:
+                st = self._values[k] = {
+                    "buckets": [0] * (len(self.buckets) + 1),
+                    "sum": 0.0, "count": 0,
+                    "min": float("inf"), "max": float("-inf")}
+            st["buckets"][bisect.bisect_left(self.buckets, v)] += 1
+            st["sum"] += v
+            st["count"] += 1
+            st["min"] = min(st["min"], v)
+            st["max"] = max(st["max"], v)
+
+    def summary(self, **labels) -> Optional[dict]:
+        with self._lock:
+            st = self._values.get(_label_key(labels))
+            if st is None:
+                return None
+            return {"count": st["count"], "sum": st["sum"],
+                    "mean": st["sum"] / max(st["count"], 1),
+                    "min": st["min"], "max": st["max"]}
+
+    def _snapshot(self):
+        with self._lock:
+            out = {}
+            for k, st in self._values.items():
+                out[_label_str(k)] = {
+                    "count": st["count"], "sum": round(st["sum"], 9),
+                    "mean": round(st["sum"] / max(st["count"], 1), 9),
+                    "min": st["min"], "max": st["max"]}
+            return out
+
+    def _prometheus(self, lines):
+        with self._lock:
+            for k, st in sorted(self._values.items()):
+                cum = 0
+                for ub, n in zip(self.buckets, st["buckets"]):
+                    cum += n
+                    le = 'le="%s"' % ub
+                    lines.append(f"{self.name}_bucket"
+                                 f"{_prom_labels(k, le)} {cum}")
+                cum += st["buckets"][-1]
+                inf = 'le="+Inf"'
+                lines.append(f"{self.name}_bucket"
+                             f"{_prom_labels(k, inf)} {cum}")
+                lines.append(f"{self.name}_sum{_prom_labels(k)} {st['sum']}")
+                lines.append(f"{self.name}_count{_prom_labels(k)} "
+                             f"{st['count']}")
+
+
+class Registry:
+    """Name -> metric store. `counter`/`gauge`/`histogram` are
+    get-or-create; asking for an existing name with a different kind is a
+    programming error and raises."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+        # bumped on reset() so holders of cached metric handles (e.g. the
+        # steplog's hot path) can detect that their handle was orphaned
+        self._generation = 0
+
+    def generation(self) -> int:
+        return self._generation
+
+    def _get_or_create(self, cls, name, help, **kw) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help, **kw)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self):
+        with self._lock:
+            return sorted(self._metrics)
+
+    def snapshot(self) -> Dict[str, dict]:
+        """JSON-safe dict: name -> {kind, help, values: {labelstr: v}}."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return {m.name: {"kind": m.kind, "help": m.help,
+                         "values": m._snapshot()} for m in metrics}
+
+    def to_json(self, indent=None) -> str:
+        return json.dumps(self.snapshot(), indent=indent, sort_keys=True)
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format (scrape-compatible)."""
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        lines = []
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            m._prometheus(lines)
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self):
+        """Drop every metric (definitions included)."""
+        with self._lock:
+            self._metrics.clear()
+            self._generation += 1
+
+
+_registry = Registry()
+
+
+def default_registry() -> Registry:
+    return _registry
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return _registry.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return _registry.gauge(name, help)
+
+
+def histogram(name: str, help: str = "", buckets=DEFAULT_BUCKETS) -> Histogram:
+    return _registry.histogram(name, help, buckets=buckets)

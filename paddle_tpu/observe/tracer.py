@@ -1,0 +1,153 @@
+"""Structured span tracer: a bounded ring buffer of timed events with
+parent/child nesting and chrome://tracing JSON export.
+
+Reference analog: platform/profiler.cc's RecordEvent host-event table +
+tools/timeline.py's chrome-trace conversion, unified into one store. The
+`paddle_tpu.profiler` module's `record_event` / `print_host_events` /
+`export_chrome_tracing` API is now a thin veneer over this tracer, so
+host annotations, executor step phases, trainer epoch marks and RPC spans
+all land in ONE timeline.
+
+The ring is bounded (default 16384 events): a week-long training run
+cannot grow host memory through telemetry — old events fall off the back,
+aggregate counts live in observe.metrics instead.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+DEFAULT_CAPACITY = 16384
+
+
+class Span:
+    """One completed timed event (chrome "X" phase)."""
+
+    __slots__ = ("name", "cat", "ts", "dur", "tid", "depth", "args")
+
+    def __init__(self, name: str, cat: str, ts: float, dur: float,
+                 tid: int, depth: int = 0, args: Optional[dict] = None):
+        self.name = name
+        self.cat = cat
+        self.ts = ts          # wall-clock seconds (time.time epoch)
+        self.dur = dur        # seconds (perf_counter delta)
+        self.tid = tid
+        self.depth = depth
+        self.args = args or {}
+
+    def __repr__(self):
+        return (f"Span({self.name!r}, cat={self.cat!r}, "
+                f"dur={self.dur * 1e3:.3f}ms, depth={self.depth})")
+
+
+class Tracer:
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._events: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._local = threading.local()
+
+    @property
+    def capacity(self) -> int:
+        return self._events.maxlen
+
+    def set_capacity(self, capacity: int):
+        """Re-bound the ring, keeping the most recent events that fit."""
+        with self._lock:
+            self._events = deque(self._events, maxlen=capacity)
+
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "host", **args):
+        """Timed nested region. The event is recorded even when the body
+        raises (the failing iteration is usually the one being profiled);
+        nesting depth is tracked per thread and stored on the event."""
+        stack = self._stack()
+        depth = len(stack)
+        stack.append(name)
+        ts = time.time()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dur = time.perf_counter() - t0
+            stack.pop()
+            self.record(name, ts, dur, cat=cat, depth=depth,
+                        parent=stack[-1] if stack else None, **args)
+
+    def record(self, name: str, ts: float, dur: float, cat: str = "host",
+               tid: Optional[int] = None, depth: int = 0, parent=None,
+               **args):
+        """Append a completed span directly (for callers that timed the
+        region themselves, e.g. the executor's phase timers)."""
+        if parent is not None:
+            args = dict(args, parent=parent)
+        ev = Span(name, cat, ts, dur,
+                  tid if tid is not None else threading.get_ident(),
+                  depth, args)
+        with self._lock:
+            self._events.append(ev)
+        return ev
+
+    def events(self, cat: Optional[str] = None) -> List[Span]:
+        with self._lock:
+            evs = list(self._events)
+        if cat is not None:
+            evs = [e for e in evs if e.cat == cat]
+        return evs
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+
+    def __len__(self):
+        with self._lock:
+            return len(self._events)
+
+    # -- aggregation (the reference DisableProfiler printed table) ---------
+    def aggregate(self, cat: Optional[str] = None) -> Dict[str, list]:
+        """name -> [calls, total_s, max_s, min_s] over recorded events."""
+        agg: Dict[str, list] = {}
+        for e in self.events(cat=cat):
+            a = agg.setdefault(e.name, [0, 0.0, 0.0, float("inf")])
+            a[0] += 1
+            a[1] += e.dur
+            a[2] = max(a[2], e.dur)
+            a[3] = min(a[3], e.dur)
+        return agg
+
+    # -- chrome://tracing export -------------------------------------------
+    def chrome_events(self, cat: Optional[str] = None) -> List[dict]:
+        out = []
+        for e in self.events(cat=cat):
+            ev = {"name": e.name, "ph": "X", "pid": 0, "tid": e.tid,
+                  "ts": int(e.ts * 1e6), "dur": int(e.dur * 1e6),
+                  "cat": e.cat}
+            if e.args or e.depth:
+                ev["args"] = dict(e.args, depth=e.depth)
+            out.append(ev)
+        return out
+
+    def export_chrome(self, path: str, cat: Optional[str] = None) -> str:
+        """Write the ring as chrome://tracing JSON (reference
+        tools/timeline.py emits the same schema from the profiler proto)."""
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.chrome_events(cat=cat),
+                       "displayTimeUnit": "ms"}, f)
+        return path
+
+
+_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _tracer

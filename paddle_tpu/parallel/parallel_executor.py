@@ -18,6 +18,7 @@ reference details/reduce_op_handle.cc) maps to sharding optimizer state over
 from __future__ import annotations
 
 import enum
+import time
 from typing import Dict, Optional, Sequence
 
 import jax
@@ -28,6 +29,7 @@ from ..core import ir
 from ..core.executor import (Scope, _CompiledProgram, _StateCache,
                              _evict_stale_versions, _evict_superseded,
                              global_scope)
+from ..observe import steplog as _steplog
 from . import mesh as mesh_lib
 
 
@@ -168,15 +170,20 @@ class ParallelExecutor:
                     merged.setdefault(k, []).append(np.asarray(v))
             feed = {k: np.concatenate(v, axis=0) for k, v in merged.items()}
 
+        from .. import flags as _flags
+        obs_on = _flags.get_flag("observe")
+        t0 = time.perf_counter() if obs_on else 0.0
         fetch_names = [f.name if isinstance(f, ir.Variable) else str(f)
                        for f in fetch_list]
         feed_arrays = self._convert_feeds(feed)
+        if obs_on:
+            t_fc = time.perf_counter()  # end of feed conversion proper
 
-        from .. import flags as _flags
         fast_key = (self._program._uid, self._program._version,
                     frozenset(feed_arrays), tuple(fetch_names),
                     _flags.version())
         hit = self._fast.get(fast_key)
+        bound = hit is None
         if hit is None:
             from ..core.executor import resolve_compiler_options
             copts = resolve_compiler_options(
@@ -187,6 +194,11 @@ class ParallelExecutor:
                    tuple(sorted(copts.items())) if copts else None)
             compiled = self._cache.get(key)
             if compiled is None:
+                _steplog.observatory().note_entry_build(
+                    self._program._uid, self._program._version,
+                    tuple(sorted(feed_arrays)), tuple(fetch_names),
+                    tuple(sorted(copts.items())) if copts else None,
+                    source="parallel", scope_uid=self._scope._uid)
                 compiled = _CompiledProgram(self._program, sorted(feed_arrays),
                                             fetch_names, self._scope,
                                             donate=True,
@@ -204,16 +216,41 @@ class ParallelExecutor:
             hit = self._fast[fast_key] = (compiled, key)
         compiled, self._last_key = hit
 
+        if obs_on:
+            _steplog.track_shapes(compiled, self._program._uid, feed_arrays,
+                                  source="parallel")
+            t1 = time.perf_counter()
         # per-program run counter (see Executor.run): deterministic
         # trajectories from seeded init, per-step mask variation
         counter = np.uint32(self._run_counter)
         self._run_counter += 1
         mut, const = self._state_cache.get(compiled, self._scope)
+        if obs_on:
+            t2 = time.perf_counter()
         fetches, new_state = compiled.run_with_state(
             self._scope, feed_arrays, mut, const, counter)
+        if obs_on:
+            t3 = time.perf_counter()
         self._state_cache.commit(compiled, self._scope, new_state)
+        if obs_on:
+            t4 = time.perf_counter()
         if return_numpy:
             fetches = [self._fetch_numpy(f) for f in fetches]
+        if obs_on:
+            t5 = time.perf_counter()
+            phases = {
+                "feed_convert": t_fc - t0,
+                "state_gather": t2 - t1,
+                "device_compute": t3 - t2,
+                "write_back": t4 - t3,
+                "fetch": t5 - t4,
+            }
+            if bound:
+                # one-shot memo-resolution/build cost, kept out of the
+                # steady-state feed_convert numbers
+                phases["bind"] = t1 - t_fc
+            _steplog.get_steplog().record(_steplog.StepStats(
+                self._program._uid, "parallel", time.time(), phases))
         return fetches
 
     @staticmethod

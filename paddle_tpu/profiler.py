@@ -4,6 +4,13 @@ TPU-native redesign: the reference's CUPTI device tracer + event profiler map
 onto the JAX/XLA profiler, which captures both host events and device (TPU)
 trace timelines into TensorBoard/perfetto format. The `profiler` context
 manager keeps the reference API shape (state, sorted_key, output path).
+
+The host-event table behind `record_event` / `print_host_events` /
+`export_chrome_tracing` is the `observe.tracer` ring buffer (fluid-scope,
+round 8): events are BOUNDED (old ones fall off the back instead of
+growing host memory across a long run), nested spans carry depth/parent,
+and executor step phases, trainer epoch marks and RPC spans share the
+same timeline + export path.
 """
 
 from __future__ import annotations
@@ -11,18 +18,41 @@ from __future__ import annotations
 import contextlib
 import os
 import time
-from collections import defaultdict
+import warnings
 
 import jax
 
-_events = []
+from .observe import tracer as _tracer_mod
+
+# TPU-native states. "GPU" is accepted as a deprecated alias (reference
+# scripts pass it); there is no CUDA device here — the XLA trace simply
+# captures whatever accelerator backend is active.
+_STATES = ("CPU", "TPU", "All")
+_DEPRECATED_STATES = ("GPU",)
+
+
+def _check_state(state: str) -> str:
+    if state in _DEPRECATED_STATES:
+        warnings.warn(
+            f"profiler state {state!r} is a deprecated alias on the "
+            f"TPU-native build; use 'TPU' (or 'All')", DeprecationWarning,
+            stacklevel=3)
+        return state
+    if state not in _STATES:
+        raise ValueError(
+            f"state must be CPU / TPU / All (got {state!r}; 'GPU' is "
+            f"accepted as a deprecated alias)")
+    return state
+
+
+def _host_tracer() -> _tracer_mod.Tracer:
+    return _tracer_mod.get_tracer()
 
 
 @contextlib.contextmanager
 def profiler(state="All", sorted_key=None, profile_path="/tmp/profile"):
     """reference profiler.py:profiler — wraps jax.profiler trace capture."""
-    if state not in ("CPU", "GPU", "TPU", "All"):
-        raise ValueError("state must be CPU / TPU / All")
+    _check_state(state)
     os.makedirs(profile_path, exist_ok=True)
     jax.profiler.start_trace(profile_path)
     t0 = time.time()
@@ -38,19 +68,17 @@ def profiler(state="All", sorted_key=None, profile_path="/tmp/profile"):
 @contextlib.contextmanager
 def record_event(name: str):
     """reference platform::RecordEvent analog -> jax named annotation.
-    Events also land in the host table (print_host_events) and the chrome
-    trace export (export_chrome_tracing)."""
+    Events also land in the bounded host-event ring (print_host_events)
+    and the chrome trace export (export_chrome_tracing). Recorded even
+    when the body raises — the failing iteration is usually the one being
+    profiled."""
     with jax.profiler.TraceAnnotation(name):
-        t0 = time.time()
-        try:
+        with _host_tracer().span(name, cat="host"):
             yield
-        finally:
-            # record even when the body raises — the failing iteration is
-            # usually the one being profiled
-            _events.append((name, t0, time.time() - t0))
 
 
 def start_profiler(state="All", profile_path="/tmp/profile"):
+    _check_state(state)
     os.makedirs(profile_path, exist_ok=True)
     jax.profiler.start_trace(profile_path)
 
@@ -60,7 +88,8 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
 
 
 def reset_profiler():
-    _events.clear()
+    """Clear the host-event ring (reference ResetProfiler)."""
+    _host_tracer().clear()
 
 
 @contextlib.contextmanager
@@ -75,13 +104,7 @@ def print_host_events(sorted_key="total"):
     table, profiler.cc:448). Device-level op times live in the XLA trace
     captured by `profiler` (TensorBoard/perfetto) — under jit there are no
     per-op kernel launches to time on the host, by design."""
-    agg = defaultdict(lambda: [0, 0.0, 0.0, float("inf")])
-    for name, _t0, dt in _events:
-        a = agg[name]
-        a[0] += 1
-        a[1] += dt
-        a[2] = max(a[2], dt)
-        a[3] = min(a[3], dt)
+    agg = _host_tracer().aggregate(cat="host")
     keyfn = {"total": lambda kv: -kv[1][1], "calls": lambda kv: -kv[1][0],
              "max": lambda kv: -kv[1][2], "min": lambda kv: kv[1][3],
              "ave": lambda kv: -kv[1][1] / kv[1][0]}.get(
@@ -99,11 +122,7 @@ def print_host_events(sorted_key="total"):
 def export_chrome_tracing(path: str):
     """Write recorded host events as chrome://tracing JSON (reference
     tools/timeline.py:21 converts the profiler proto the same way; device
-    timelines come from the perfetto trace jax.profiler writes)."""
-    import json
-    events = [{"name": name, "ph": "X", "pid": 0, "tid": 0,
-               "ts": int(t0 * 1e6), "dur": int(dt * 1e6),
-               "cat": "host"} for name, t0, dt in _events]
-    with open(path, "w") as f:
-        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
-    return path
+    timelines come from the perfetto trace jax.profiler writes). Exports
+    the WHOLE telemetry timeline — record_event spans plus executor step
+    phases and any other tracer category."""
+    return _host_tracer().export_chrome(path)

@@ -21,10 +21,13 @@ host boundary where TPUs require it.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from .. import flags as _flags
+from ..observe import metrics as _metrics
 from . import rpc
 
 
@@ -57,18 +60,30 @@ class PSClient:
                              "init_param", "init_table"})
 
     def _call(self, endpoint, cmd, **payload):
+        obs = _flags.get_flag("observe")
+        t0 = time.perf_counter() if obs else 0.0
+        tx = rx = 0
         with self._lock:
             ep_lock = self._ep_locks.setdefault(endpoint, threading.Lock())
         with ep_lock:  # one in-flight request per connection
             try:
                 sock = self._sock(endpoint)
-                rpc.send_msg(sock, (cmd, payload))
-                status, value = rpc.recv_msg(sock)
+                tx = rpc.send_msg(sock, (cmd, payload))
+                (status, value), rx = rpc.recv_msg(sock, with_size=True)
             except (ConnectionError, EOFError, OSError):
                 if cmd not in self._IDEMPOTENT:
+                    if obs:
+                        _metrics.counter(
+                            "pserver_client_errors_total",
+                            "client RPCs failed without retry").inc(cmd=cmd)
                     raise
                 # transparent one-shot reconnect for idempotent RPCs, as
                 # the reference's gRPC channel re-dials dropped channels
+                if obs:
+                    _metrics.counter(
+                        "pserver_client_retries_total",
+                        "idempotent RPCs replayed after a dropped "
+                        "connection").inc(cmd=cmd)
                 with self._lock:
                     old = self._socks.pop(endpoint, None)
                 if old is not None:
@@ -77,8 +92,22 @@ class PSClient:
                     except OSError:
                         pass
                 sock = self._sock(endpoint)
-                rpc.send_msg(sock, (cmd, payload))
-                status, value = rpc.recv_msg(sock)
+                tx = rpc.send_msg(sock, (cmd, payload))
+                (status, value), rx = rpc.recv_msg(sock, with_size=True)
+        if obs:
+            _metrics.counter(
+                "pserver_client_requests_total",
+                "client RPCs by command (push/pull counts)").inc(cmd=cmd)
+            _metrics.counter(
+                "pserver_client_bytes_sent_total",
+                "wire bytes sent to pservers").inc(tx, cmd=cmd)
+            _metrics.counter(
+                "pserver_client_bytes_received_total",
+                "wire bytes received from pservers").inc(rx, cmd=cmd)
+            _metrics.histogram(
+                "pserver_client_rpc_seconds",
+                "client-observed RPC latency").observe(
+                    time.perf_counter() - t0, cmd=cmd)
         if status != "ok":
             raise RuntimeError(f"pserver {endpoint} {cmd}: {value}")
         return value

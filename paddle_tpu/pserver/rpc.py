@@ -51,15 +51,24 @@ class _RestrictedUnpickler(pickle.Unpickler):
             f"pserver wire protocol forbids {module}.{name}")
 
 
-def send_msg(sock: socket.socket, obj: Any) -> None:
+def send_msg(sock: socket.socket, obj: Any) -> int:
+    """Send one length-prefixed message; returns the wire byte count so
+    observing callers can account traffic without re-serializing."""
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     sock.sendall(_HDR.pack(len(payload)) + payload)
+    return _HDR.size + len(payload)
 
 
-def recv_msg(sock: socket.socket) -> Any:
+def recv_msg(sock: socket.socket, with_size: bool = False) -> Any:
+    """Receive one message. `with_size=True` returns (obj, wire_bytes)
+    for telemetry callers; the default keeps the legacy single-value
+    return."""
     header = _recv_exact(sock, _HDR.size)
     (n,) = _HDR.unpack(header)
-    return _RestrictedUnpickler(io.BytesIO(_recv_exact(sock, n))).load()
+    obj = _RestrictedUnpickler(io.BytesIO(_recv_exact(sock, n))).load()
+    if with_size:
+        return obj, _HDR.size + n
+    return obj
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:

@@ -23,10 +23,13 @@ import logging
 import os
 import socket
 import threading
+import time
 from typing import Dict, Optional
 
 import numpy as np
 
+from .. import flags as _flags
+from ..observe import metrics as _metrics
 from . import rpc
 from .optim import make_optimizer
 
@@ -135,7 +138,7 @@ class ParameterServer:
         try:
             while not self._stop.is_set():
                 try:
-                    msg = rpc.recv_msg(conn)
+                    msg, rx = rpc.recv_msg(conn, with_size=True)
                 except (ConnectionError, EOFError, OSError):
                     return
                 if self._stop.is_set():
@@ -145,11 +148,36 @@ class ParameterServer:
                     # depend on stop() being a hard cut)
                     return
                 cmd, payload = msg
+                obs = _flags.get_flag("observe")
+                t0 = time.perf_counter() if obs else 0.0
                 try:
                     reply = self._dispatch(cmd, payload)
                 except Exception as e:  # surface server errors to the client
                     reply = ("err", f"{type(e).__name__}: {e}")
-                rpc.send_msg(conn, reply)
+                # handler latency measured BEFORE the reply send: sendall
+                # blocks on a slow-reading client and that network stall
+                # must not masquerade as handler time
+                handler_s = time.perf_counter() - t0 if obs else 0.0
+                tx = rpc.send_msg(conn, reply)
+                if obs:
+                    _metrics.counter(
+                        "pserver_server_requests_total",
+                        "RPCs served, by command").inc(cmd=cmd)
+                    _metrics.counter(
+                        "pserver_server_bytes_received_total",
+                        "wire bytes received by the server").inc(rx, cmd=cmd)
+                    _metrics.counter(
+                        "pserver_server_bytes_sent_total",
+                        "wire bytes sent in replies").inc(tx, cmd=cmd)
+                    _metrics.histogram(
+                        "pserver_server_handler_seconds",
+                        "server-side handler latency (excludes socket "
+                        "wait)").observe(handler_s, cmd=cmd)
+                    if reply[0] == "err":
+                        _metrics.counter(
+                            "pserver_server_errors_total",
+                            "handler errors surfaced to clients").inc(
+                                cmd=cmd)
                 if cmd == "stop":
                     return
         finally:

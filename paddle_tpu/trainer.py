@@ -12,11 +12,15 @@ from __future__ import annotations
 
 import os
 import shutil
+import time
 from typing import Callable, List, Optional
 
 import numpy as np
 
+from . import flags as _flags
 from . import io as fluid_io
+from .observe import metrics as _obs_metrics
+from .observe import tracer as _obs_tracer
 from . import unique_name
 from .core import ir
 from .core.executor import Executor, Scope, TPUPlace, global_scope
@@ -217,6 +221,8 @@ class Trainer:
         start_epoch = self.checkpoint_cfg.epoch_id if self.checkpoint_cfg else 0
         for epoch in range(start_epoch, num_epochs):
             event_handler(BeginEpochEvent(epoch))
+            epoch_ts, epoch_t0 = time.time(), time.perf_counter()
+            epoch_start_step = step
             for batch in reader():
                 begin = BeginStepEvent(epoch, step)
                 event_handler(begin)
@@ -233,6 +239,23 @@ class Trainer:
                         trainer_args={"epoch_id": epoch, "step_id": step},
                         max_num_checkpoints=self.checkpoint_cfg.max_num_checkpoints,
                         scope=self.scope)
+            if _flags.get_flag("observe"):
+                # per-epoch summary (per-step phases come from the
+                # executor's steplog; this adds the epoch envelope)
+                dur = time.perf_counter() - epoch_t0
+                n_steps = step - epoch_start_step
+                _obs_metrics.counter(
+                    "trainer_epochs_total", "completed epochs").inc()
+                _obs_metrics.histogram(
+                    "trainer_epoch_seconds", "wall time per epoch"
+                ).observe(dur)
+                _obs_metrics.gauge(
+                    "trainer_last_epoch_steps",
+                    "steps run in the most recent epoch").set(n_steps)
+                _obs_tracer.get_tracer().record(
+                    "epoch", epoch_ts, dur, cat="trainer", epoch=epoch,
+                    steps=n_steps,
+                    steps_per_sec=round(n_steps / dur, 3) if dur else 0.0)
             event_handler(EndEpochEvent(epoch))
 
     def test(self, reader, feed_order):

@@ -1,0 +1,399 @@
+"""fluid-scope telemetry (round 8): metrics registry, span tracer,
+steplog + recompilation observatory, and the flag-gated wiring through
+the executor, feeder, trainer, and pserver RPC layers."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, observe
+from paddle_tpu.observe import metrics as obm
+from paddle_tpu.observe.tracer import Tracer
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    fluid.set_flag("observe", False)
+    observe.reset()
+    yield
+    fluid.set_flag("observe", False)
+    observe.reset()
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+def test_metrics_counter_gauge_histogram_with_labels():
+    reg = obm.Registry()
+    c = reg.counter("requests_total", "total requests")
+    c.inc(cmd="push")
+    c.inc(3, cmd="push")
+    c.inc(cmd="pull")
+    assert c.value(cmd="push") == 4
+    assert c.value(cmd="pull") == 1
+    assert c.total() == 5
+
+    g = reg.gauge("queue_depth")
+    g.set(7)
+    g.inc(2)
+    assert g.value() == 9
+
+    h = reg.histogram("latency_seconds")
+    for v in (0.001, 0.002, 0.5):
+        h.observe(v, cmd="push")
+    s = h.summary(cmd="push")
+    assert s["count"] == 3
+    assert s["min"] == pytest.approx(0.001)
+    assert s["max"] == pytest.approx(0.5)
+    assert s["mean"] == pytest.approx((0.001 + 0.002 + 0.5) / 3)
+
+    snap = reg.snapshot()
+    assert snap["requests_total"]["kind"] == "counter"
+    assert snap["requests_total"]["values"]["cmd=push"] == 4
+    assert snap["latency_seconds"]["values"]["cmd=push"]["count"] == 3
+    # snapshot is JSON-safe end to end
+    json.loads(reg.to_json())
+
+
+def test_metrics_prometheus_exposition():
+    reg = obm.Registry()
+    reg.counter("a_total", "help text").inc(5, kind="x")
+    reg.gauge("b").set(2.5)
+    reg.histogram("c_seconds").observe(0.05)
+    text = reg.to_prometheus()
+    assert "# HELP a_total help text" in text
+    assert "# TYPE a_total counter" in text
+    assert 'a_total{kind="x"} 5' in text
+    assert "# TYPE b gauge" in text
+    assert "b 2.5" in text
+    assert "# TYPE c_seconds histogram" in text
+    assert 'c_seconds_bucket{le="+Inf"} 1' in text
+    assert "c_seconds_count 1" in text
+
+
+def test_metrics_kind_mismatch_raises_and_threads_are_safe():
+    reg = obm.Registry()
+    reg.counter("m")
+    with pytest.raises(TypeError, match="already registered as counter"):
+        reg.gauge("m")
+
+    c = reg.counter("hits_total")
+
+    def worker():
+        for _ in range(1000):
+            c.inc(tid="shared")
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert c.value(tid="shared") == 4000
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+def test_tracer_nesting_and_ring_bound():
+    tr = Tracer(capacity=8)
+    with tr.span("outer"):
+        with tr.span("inner"):
+            pass
+    evs = {e.name: e for e in tr.events()}
+    assert evs["inner"].depth == 1
+    assert evs["inner"].args["parent"] == "outer"
+    assert evs["outer"].depth == 0
+    for i in range(20):
+        tr.record(f"e{i}", time.time(), 0.0)
+    assert len(tr) == 8  # bounded: old events fell off the back
+    tr.set_capacity(4)
+    assert len(tr) == 4
+    tr.clear()
+    assert len(tr) == 0
+
+
+def test_chrome_trace_roundtrip_has_required_fields(tmp_path):
+    """Tier-1 CI check: the chrome://tracing export must round-trip
+    through json.loads with every required event field present."""
+    tr = Tracer(capacity=64)
+    with tr.span("phase_a", cat="host", note="x"):
+        with tr.span("phase_b", cat="host"):
+            time.sleep(0.002)
+    path = str(tmp_path / "trace.json")
+    tr.export_chrome(path)
+    with open(path) as f:
+        doc = json.loads(f.read())
+    assert doc["displayTimeUnit"] == "ms"
+    evs = doc["traceEvents"]
+    assert len(evs) == 2
+    for ev in evs:
+        for field in ("name", "ph", "pid", "tid", "ts", "dur", "cat"):
+            assert field in ev, f"missing {field} in {ev}"
+        assert ev["ph"] == "X"
+        assert isinstance(ev["ts"], int) and isinstance(ev["dur"], int)
+    by_name = {e["name"]: e for e in evs}
+    assert by_name["phase_b"]["dur"] >= 1500  # ~2ms in µs
+    assert by_name["phase_b"]["args"]["parent"] == "phase_a"
+
+
+# ---------------------------------------------------------------------------
+# recompilation observatory through the real executor
+# ---------------------------------------------------------------------------
+
+def _mlp():
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    loss = layers.mean(layers.fc(input=x, size=2))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def test_recompile_constant_shape_compiles_once_new_shape_is_feed_shape():
+    loss = _mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.set_flag("observe", True)
+    prepared = exe.prepare(fluid.default_main_program(), fetch_list=[loss])
+    uid = fluid.default_main_program()._uid
+
+    def events():
+        return [e for e in observe.observatory().events()
+                if e.program_uid == uid]
+
+    feed = {"x": np.ones((4, 4), np.float32)}
+    prepared.run(feed)
+    prepared.run(dict(feed))  # same shape again: NO new event
+    assert [e.cause for e in events()] == ["first_call"]
+
+    prepared.run({"x": np.ones((6, 4), np.float32)})  # new batch shape
+    causes = [e.cause for e in events()]
+    assert causes == ["first_call", "feed_shape"]
+    # the event carries the offending shapes for diagnosis
+    assert events()[-1].detail["shapes"]["x"] == [6, 4]
+    # and the metrics registry saw it
+    c = observe.default_registry().get("executor_recompiles_total")
+    assert c.value(cause="feed_shape", source="executor") == 1
+
+
+def test_recompile_program_mutation_attributed_program_version():
+    loss = _mlp()
+    main = fluid.default_main_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.set_flag("observe", True)
+    feed = {"x": np.ones((4, 4), np.float32)}
+    exe.run(main, feed=feed, fetch_list=[loss])
+    # mutate the program: version bumps, the next run() re-prepares and
+    # the compile-cache miss must be attributed to the mutation
+    with fluid.program_guard(main):
+        layers.mean(layers.scale(fluid.get_var("x"), scale=2.0))
+    exe.run(main, feed=feed, fetch_list=[loss])
+    causes = [e.cause for e in observe.observatory().events()
+              if e.program_uid == main._uid]
+    assert causes == ["first_call", "program_version"]
+
+
+def test_recompile_new_scope_attributed():
+    loss = _mlp()
+    main = fluid.default_main_program()
+    startup = fluid.default_startup_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    fluid.set_flag("observe", True)
+    feed = {"x": np.ones((4, 4), np.float32)}
+    s1, s2 = fluid.Scope(), fluid.Scope()
+    for s in (s1, s2):
+        exe.run(startup, scope=s)
+        exe.run(main, feed=feed, fetch_list=[loss], scope=s)
+    causes = [e.cause for e in observe.observatory().events()
+              if e.program_uid == main._uid]
+    assert causes == ["first_call", "new_scope"]
+
+
+def test_observe_off_zero_registry_writes_on_hot_path():
+    loss = _mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    prepared = exe.prepare(fluid.default_main_program(), fetch_list=[loss])
+    feed = {"x": np.ones((4, 4), np.float32)}
+    prepared.run(feed)  # bind + compile with the flag still off
+    observe.default_registry().reset()
+    observe.get_steplog().clear()
+    for _ in range(3):
+        prepared.run(feed)
+    # flag off => the steady-state loop wrote NOTHING
+    assert observe.default_registry().names() == []
+    assert observe.get_steplog().phase_summary()["steps"] == 0
+
+
+def test_step_stats_phases_recorded_when_observing():
+    loss = _mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.set_flag("observe", True)
+    prepared = exe.prepare(fluid.default_main_program(), fetch_list=[loss])
+    feed = {"x": np.ones((4, 4), np.float32)}
+    prepared.run(feed)
+    prepared.run(feed)
+    recent = observe.get_steplog().recent()
+    assert len(recent) == 2
+    # the binding step carries its one-shot cost as a separate `bind`
+    # phase; the steady-state step does not
+    assert "bind" in recent[0].phases
+    st = recent[-1].as_dict()
+    assert set(st["phases_us"]) == {"feed_convert", "state_gather",
+                                    "device_compute", "write_back", "fetch"}
+    assert st["total_us"] > 0
+    assert st["source"] == "executor"
+    # counters + per-phase histograms landed in the registry
+    assert observe.default_registry().get(
+        "executor_steps_total").value(source="executor") == 2
+    h = observe.default_registry().get("executor_step_phase_us")
+    assert h.summary(phase="device_compute", source="executor")["count"] == 2
+    # ... and each step left a span on the unified timeline
+    assert len(observe.get_tracer().events(cat="step")) == 2
+
+
+# ---------------------------------------------------------------------------
+# profiler satellites: state validation + bounded host-event store
+# ---------------------------------------------------------------------------
+
+def test_profiler_state_message_and_deprecated_gpu_alias():
+    from paddle_tpu import profiler as prof
+    with pytest.raises(ValueError, match=r"CPU / TPU / All"):
+        prof._check_state("XPU")
+    for ok in ("CPU", "TPU", "All"):
+        assert prof._check_state(ok) == ok
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert prof._check_state("GPU") == "GPU"
+    assert any(issubclass(x.category, DeprecationWarning) for x in w)
+
+
+def test_profiler_host_event_store_is_bounded():
+    from paddle_tpu import profiler as prof
+    tr = observe.get_tracer()
+    old_cap = tr.capacity
+    tr.set_capacity(16)
+    try:
+        for i in range(40):
+            with prof.record_event(f"ev_{i}"):
+                pass
+        assert len(tr) <= 16
+        rows = prof.print_host_events()
+        assert 0 < len(rows) <= 16
+        prof.reset_profiler()
+        assert len(tr) == 0
+        assert prof.print_host_events() == []
+    finally:
+        tr.set_capacity(old_cap)
+
+
+# ---------------------------------------------------------------------------
+# feeder + pserver wiring
+# ---------------------------------------------------------------------------
+
+def test_async_feeder_queue_metrics():
+    fluid.set_flag("observe", True)
+
+    def reader():
+        for i in range(5):
+            yield [i]
+
+    feeder = fluid.AsyncFeeder(lambda batch: {"x": np.asarray(batch)},
+                               reader, capacity=2)
+    out = list(feeder)
+    assert len(out) == 5
+    reg = observe.default_registry()
+    assert reg.get("feeder_batches_total").total() == 5
+    assert reg.get("feeder_queue_depth").value() is not None
+    assert reg.get("feeder_consumer_wait_seconds").summary()["count"] == 5
+
+
+def test_pserver_rpc_metrics_both_sides():
+    from paddle_tpu.pserver.client import PSClient
+    from paddle_tpu.pserver.server import ParameterServer
+
+    fluid.set_flag("observe", True)
+    ps = ParameterServer("127.0.0.1:0").start()
+    client = PSClient([ps.endpoint])
+    try:
+        client.init_param(ps.endpoint, "w", np.ones((4,), np.float32),
+                          "sgd", 0.1, {})
+        client.push_grad(ps.endpoint, "w", np.full((4,), 0.5, np.float32))
+        got = client.get_param(ps.endpoint, "w")
+        np.testing.assert_allclose(got, 0.95)
+        reg = observe.default_registry()
+        creq = reg.get("pserver_client_requests_total")
+        assert creq.value(cmd="init_param") == 1
+        assert creq.value(cmd="push_grad") == 1
+        assert creq.value(cmd="get_param") == 1
+        assert reg.get("pserver_client_bytes_sent_total").total() > 0
+        assert reg.get("pserver_client_bytes_received_total").total() > 0
+        lat = reg.get("pserver_client_rpc_seconds").summary(cmd="get_param")
+        assert lat and lat["count"] == 1
+        # server side (same process here, same registry)
+        sreq = reg.get("pserver_server_requests_total")
+        assert sreq.value(cmd="push_grad") == 1
+        assert reg.get("pserver_server_bytes_received_total").total() > 0
+    finally:
+        client.close()
+        ps.stop()
+
+
+def test_trainer_epoch_summary_metrics():
+    fluid.set_flag("observe", True)
+
+    def train_func():
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        pred = layers.fc(input=x, size=1, act=None)
+        return layers.mean(layers.square(pred - y))
+
+    trainer = fluid.Trainer(
+        train_func=train_func,
+        optimizer_func=lambda: fluid.optimizer.SGD(learning_rate=0.01),
+        place=fluid.CPUPlace())
+
+    def reader():
+        for _ in range(3):
+            yield [(np.ones(4, np.float32), np.ones(1, np.float32))]
+
+    trainer.train(num_epochs=2, reader=reader, feed_order=["x", "y"])
+    reg = observe.default_registry()
+    assert reg.get("trainer_epochs_total").total() == 2
+    assert reg.get("trainer_epoch_seconds").summary()["count"] == 2
+    assert reg.get("trainer_last_epoch_steps").value() == 3
+    epochs = observe.get_tracer().events(cat="trainer")
+    assert len(epochs) == 2 and epochs[-1].args["steps"] == 3
+
+
+# ---------------------------------------------------------------------------
+# the CI gate end to end (subprocess: fresh backend, fresh registry)
+# ---------------------------------------------------------------------------
+
+def test_telemetry_dump_assert_no_recompiles_cli():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tool = os.path.join(root, "tools", "telemetry_dump.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    ok = subprocess.run([sys.executable, tool, "--assert-no-recompiles"],
+                        capture_output=True, text=True, timeout=600,
+                        env=env, cwd=root)
+    assert ok.returncode == 0, ok.stderr
+    assert "assert-no-recompiles: OK" in ok.stderr
+    # the default dump is valid JSON
+    json.loads(ok.stdout[ok.stdout.index("{"):])
+
+    bad = subprocess.run([sys.executable, tool, "--assert-no-recompiles",
+                          "--two-shapes"],
+                         capture_output=True, text=True, timeout=600,
+                         env=env, cwd=root)
+    assert bad.returncode == 1
+    assert "feed_shape" in bad.stderr

@@ -1,5 +1,11 @@
-"""Capture a jax.profiler trace of the framework transformer step and
-print the top device ops by total self time (round-4 MFU hunt).
+"""Capture a profiler trace of the framework transformer step and print
+the top device ops by total self time (round-4 MFU hunt).
+
+Round 8: host-side timing rides the unified fluid-scope tracer
+(paddle_tpu.profiler.record_event -> observe.tracer) instead of private
+jax.profiler calls — the run leaves a host timeline
+(`host_timeline.json`, chrome://tracing) and an aggregated host-event
+table next to the device-op summary parsed from the perfetto trace.
 
 Usage: python tools/step_profile.py [--yardstick]
 """
@@ -58,7 +64,10 @@ def summarize(trace_dir, top=30):
 def main():
     import jax
 
+    from paddle_tpu import profiler as prof
+
     trace_dir = tempfile.mkdtemp(prefix="stepprof_")
+    prof.reset_profiler()
     if "--yardstick" in sys.argv:
         from tools import yardstick_transformer as y
         params = y.init_params(0)
@@ -67,22 +76,30 @@ def main():
         key = jax.random.key(0)
         params, opt, loss = y.train_step(params, opt, batch, key)
         np.asarray(loss)
-        jax.profiler.start_trace(trace_dir)
+        prof.start_profiler(profile_path=trace_dir)
         for i in range(3):
-            params, opt, loss = y.train_step(params, opt, batch,
-                                             jax.random.fold_in(key, i))
-        np.asarray(loss)
-        jax.profiler.stop_trace()
+            with prof.record_event("train_step"):
+                params, opt, loss = y.train_step(params, opt, batch,
+                                                 jax.random.fold_in(key, i))
+        with prof.record_event("fetch_sync"):
+            np.asarray(loss)
+        prof.stop_profiler()
     else:
         from tools.hlo_diff import framework_step
         _, run, out = framework_step()
         np.asarray(out[0])
-        jax.profiler.start_trace(trace_dir)
+        prof.start_profiler(profile_path=trace_dir)
         for _ in range(3):
-            out = run()
-        np.asarray(out[0])
-        jax.profiler.stop_trace()
+            with prof.record_event("train_step"):
+                out = run()
+        with prof.record_event("fetch_sync"):
+            np.asarray(out[0])
+        prof.stop_profiler()
     print("trace dir:", trace_dir)
+    host_path = os.path.join(trace_dir, "host_timeline.json")
+    prof.export_chrome_tracing(host_path)
+    print("host timeline:", host_path)
+    prof.print_host_events()
     summarize(trace_dir)
 
 

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Print/export the fluid-scope telemetry of an instrumented run.
+
+Runs a small prepared-program training loop on the CPU backend with the
+`observe` flag on, then dumps the metrics registry, the step-phase
+summary, and the recompilation observatory. The interesting CI mode:
+
+    python tools/telemetry_dump.py --assert-no-recompiles
+        exit 0 when the steady-state run compiled each program exactly
+        once (only `first_call` events)
+
+    python tools/telemetry_dump.py --assert-no-recompiles --two-shapes
+        feeds the SAME model two distinct batch shapes -> the second
+        shape is a jit cache miss attributed `feed_shape` -> exit 1.
+        This is the runtime counterpart of fluid-lint's static
+        feed-shape recompile-hazard warning (PR 2): the lint predicts
+        the hazard, the observatory proves whether it fired.
+
+Other output modes: --format json (default) | prom (Prometheus text
+exposition) | table (human summary); --trace PATH writes the unified
+chrome://tracing timeline (open in chrome://tracing or perfetto).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_model(fluid):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        pred = fluid.layers.fc(input=h, size=4, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="dump fluid-scope telemetry of a short prepared run")
+    ap.add_argument("--steps", type=int, default=3,
+                    help="training steps to run (default 3)")
+    ap.add_argument("--two-shapes", action="store_true",
+                    help="alternate two batch sizes (provokes a "
+                         "feed_shape recompile)")
+    ap.add_argument("--assert-no-recompiles", action="store_true",
+                    help="exit 1 if any compile event beyond first_call "
+                         "was recorded (CI gate)")
+    ap.add_argument("--format", choices=("json", "prom", "table"),
+                    default="json")
+    ap.add_argument("--trace", metavar="PATH",
+                    help="also write the chrome://tracing timeline here")
+    args = ap.parse_args(argv)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")  # env var alone is overridden
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import observe
+
+    fluid.set_flag("observe", True)
+
+    main_p, startup, loss = build_model(fluid)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    prepared = exe.prepare(main_p, fetch_list=[loss], scope=scope)
+
+    rng = np.random.RandomState(0)
+    batch_sizes = (8, 12) if args.two_shapes else (8,)
+    for i in range(max(args.steps, 1)):
+        bs = batch_sizes[i % len(batch_sizes)]
+        prepared.run({"x": rng.randn(bs, 16).astype(np.float32),
+                      "y": rng.randint(0, 4, (bs, 1)).astype(np.int64)})
+
+    reg = observe.default_registry()
+    obsv = observe.observatory()
+
+    if args.format == "prom":
+        print(reg.to_prometheus())
+    elif args.format == "table":
+        summ = observe.summary()
+        print(f"steps: {summ['steps']['steps']}  "
+              f"mean {summ['steps']['mean_step_us']:.1f} us/step")
+        for phase, us in sorted(summ["steps"]["phase_us"].items(),
+                                key=lambda kv: -kv[1]):
+            print(f"  {phase:<16} {us:>12.1f} us total")
+        print("recompiles:", summ["recompiles"]["counts"] or "none")
+        print("metrics:", ", ".join(reg.names()))
+    else:
+        print(json.dumps(observe.summary(), indent=2, sort_keys=True,
+                         default=str))
+
+    if args.trace:
+        observe.get_tracer().export_chrome(args.trace)
+        print(f"chrome trace written to {args.trace}", file=sys.stderr)
+
+    if args.assert_no_recompiles:
+        bad = obsv.unexpected()
+        if bad:
+            causes = sorted({e.cause for e in bad})
+            print(f"ASSERT-NO-RECOMPILES FAILED: {len(bad)} recompile "
+                  f"event(s) beyond first_call, cause(s): "
+                  f"{', '.join(causes)}", file=sys.stderr)
+            for e in bad:
+                print(f"  {e!r} detail={e.detail}", file=sys.stderr)
+            return 1
+        print("assert-no-recompiles: OK (every program compiled exactly "
+              "once)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
