@@ -79,6 +79,7 @@ from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa
 from .transpiler import memory_optimize, release_memory, InferenceTranspiler  # noqa: F401
 from . import distributed  # noqa: F401
 from . import pserver  # noqa: F401
+from . import ark  # noqa: F401  (fluid-ark fault-tolerant training)
 from . import master  # noqa: F401
 from . import recordio  # noqa: F401
 from .trainer import (Trainer, Inferencer, CheckpointConfig,  # noqa: F401

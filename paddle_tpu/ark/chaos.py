@@ -1,0 +1,184 @@
+"""Seed-deterministic fault injection for the pserver wire protocol.
+
+The chaos harness proves the recovery paths actually recover: it wraps
+`pserver.rpc.send_msg`/`recv_msg` through the module's fault hook and
+injects the failure modes a flaky network or a dying process produces —
+
+    drop      the request/reply vanishes (blackhole; the peer never sees
+              it, the caller blocks until its deadline)
+    delay     the message is late by a uniform draw from `delay_s`
+    truncate  the connection dies MID-FRAME: a prefix of the wire bytes
+              is delivered, then the socket closes (exercises the
+              `RPCConnectionError` bytes-read/expected path)
+    close     the connection dies cleanly before the message
+
+plus process-level helpers: `kill_server` is a SIGKILL-equivalent hard
+cut of an in-process `ParameterServer` (its `stop()` already drops
+in-flight requests unanswered by contract), and `restart_server` brings
+a fresh server up on the same endpoint, optionally recovering its shard
+from a checkpoint.
+
+Every decision comes from one `random.Random(seed)` stream, so a failing
+chaos run replays byte-identically. Faults are injected on ONE side
+(default the client's) selected by thread name — pserver connection
+threads are named `psconn@<endpoint>` — so a drill can separately attack
+requests and replies.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+def _rpc():
+    # lazy: pserver.client imports ark.retry, so a module-level import
+    # here would close an import cycle through the two packages
+    from ..pserver import rpc
+    return rpc
+
+
+def _is_server_thread() -> bool:
+    return threading.current_thread().name.startswith("psconn@")
+
+
+class ChaosMonkey:
+    """Install with `with ChaosMonkey(seed=..., p_drop=0.1): ...` (or
+    `.start()` / `.stop()`). Probabilities are per-message; `side`
+    selects whose sends are attacked: "client" (requests), "server"
+    (replies), or "both". Counters on the instance record what fired so
+    tests can assert the fault actually happened."""
+
+    def __init__(self, seed: int = 0, p_drop: float = 0.0,
+                 p_delay: float = 0.0, p_truncate: float = 0.0,
+                 p_close: float = 0.0,
+                 delay_s: Tuple[float, float] = (0.005, 0.05),
+                 side: str = "client"):
+        if side not in ("client", "server", "both"):
+            raise ValueError(f"side must be client/server/both, got {side!r}")
+        self.rng = random.Random(seed)
+        self.p_drop, self.p_delay = p_drop, p_delay
+        self.p_truncate, self.p_close = p_truncate, p_close
+        self.delay_s = delay_s
+        self.side = side
+        self.injected = {"drop": 0, "delay": 0, "truncate": 0, "close": 0}
+        self._lock = threading.Lock()
+        self._installed = False
+
+    # -- hook ------------------------------------------------------------
+    def _applies(self) -> bool:
+        on_server = _is_server_thread()
+        return (self.side == "both"
+                or (self.side == "server") == on_server)
+
+    def _hook(self, direction: str, sock, data: Optional[bytes]):
+        """rpc fault hook: returns the (possibly modified) bytes to send,
+        or None when the hook consumed/discarded the message itself.
+        For `recv` only delay/close apply (data is None)."""
+        if not self._applies():
+            return data
+        with self._lock:   # one deterministic decision stream
+            r = self.rng.random()
+            p = 0.0
+            for fault in ("drop", "delay", "truncate", "close"):
+                p += getattr(self, f"p_{fault}")
+                if r < p:
+                    break
+            else:
+                return data
+            # drop/truncate/close are SEND faults: the connection (or
+            # message) dies before the request leaves, which the caller
+            # may safely replay. Attacking the other direction (replies
+            # lost AFTER the server applied the request — the genuinely
+            # ambiguous failure) is side="server": the server's reply IS
+            # its send.
+            if direction == "recv" and fault != "delay":
+                return data
+            self.injected[fault] += 1
+            if fault == "delay":
+                lo, hi = self.delay_s
+                pause = lo + (hi - lo) * self.rng.random()
+            elif fault == "truncate" and data is not None:
+                cut = 1 + int(self.rng.random() * max(len(data) - 1, 1))
+        if fault == "delay":
+            time.sleep(pause)
+            return data
+        if fault == "close":
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise ConnectionResetError(
+                f"chaos: connection closed before {direction}")
+        if fault == "drop":
+            logger.debug("chaos: dropped a %d-byte message", len(data))
+            return None   # blackhole: caller believes it sent
+        # truncate: deliver a strict prefix, then kill the connection —
+        # the peer's _recv_exact dies mid-frame with RPCConnectionError
+        try:
+            sock.sendall(data[:cut])
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+        logger.debug("chaos: truncated %d-byte message at %d", len(data), cut)
+        return None
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "ChaosMonkey":
+        if self._installed:
+            return self
+        rpc = _rpc()
+        if rpc.get_fault_hook() is not None:
+            raise RuntimeError("another fault hook is already installed")
+        rpc.set_fault_hook(self._hook)
+        self._installed = True
+        return self
+
+    def stop(self) -> None:
+        if self._installed:
+            _rpc().set_fault_hook(None)
+            self._installed = False
+
+    def __enter__(self) -> "ChaosMonkey":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def total_injected(self) -> int:
+        return sum(self.injected.values())
+
+
+# -- process-level faults -------------------------------------------------
+
+def kill_server(server) -> str:
+    """SIGKILL-equivalent death of an in-process ParameterServer: the
+    listener closes and every in-flight request is dropped unanswered
+    (`_serve_conn` checks the stop event before replying). Returns the
+    endpoint so `restart_server` can reuse it."""
+    ep = server.endpoint
+    server.stop()
+    return ep
+
+
+def restart_server(endpoint: str, trainers: int = 1,
+                   sync_timeout: float = 120.0,
+                   recover_dir: Optional[str] = None):
+    """Bring a fresh ParameterServer up on `endpoint`, recovering its
+    shard (values + optimizer slots + sparse tables) from `recover_dir`
+    when given — the crash/restart leg of the drill."""
+    from ..pserver.server import ParameterServer
+
+    srv = ParameterServer(endpoint, trainers=trainers,
+                          sync_timeout=sync_timeout).start()
+    if recover_dir is not None:
+        srv.recover(recover_dir)
+    return srv

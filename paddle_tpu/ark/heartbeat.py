@@ -1,0 +1,84 @@
+"""Trainer-side heartbeat: renew a liveness lease on every pserver.
+
+The server half lives in `pserver/server.py` (`_h_heartbeat` + the
+`LeaseTable`-backed `EvictingBarrier`); this is the client half — a
+daemon thread that renews the lease at `lease_s / 3` so two consecutive
+losses still leave slack before expiry (the classic lease-renewal rule).
+Heartbeats ride the normal RPC path with a SHORT deadline: a wedged
+pserver must not wedge the heartbeat loop, and a missed beat is counted,
+not raised — liveness signaling is best-effort by design.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+class HeartbeatThread:
+    """Renews `trainer_id`'s lease on every endpoint until `stop()`.
+
+    `lease_s` is the server-side lease duration; the renewal interval
+    defaults to a third of it. Failures are swallowed (and metered when
+    `observe` is on): the lease simply expires if the server is gone,
+    which is exactly the signal the eviction path wants."""
+
+    def __init__(self, client, endpoints: Sequence[str], trainer_id: int,
+                 session=None, lease_s: float = 3.0,
+                 interval: Optional[float] = None):
+        self.client = client
+        self.endpoints = list(endpoints)
+        self.trainer_id = int(trainer_id)
+        self.session = session
+        self.lease_s = float(lease_s)
+        self.interval = float(interval) if interval else self.lease_s / 3.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "HeartbeatThread":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"heartbeat[trainer={self.trainer_id}]")
+        self._thread.start()
+        return self
+
+    def beat_once(self) -> int:
+        """One renewal round, all endpoints CONCURRENTLY (the client's
+        per-endpoint pool); returns how many acknowledged. Concurrency
+        matters: renewed serially, one blackholed pserver's deadline
+        would delay renewals to the healthy ones past the lease and get
+        this live trainer falsely evicted. Used synchronously at startup
+        so the lease exists before the first sync barrier."""
+        futs = {ep: self.client._pool.submit(
+                    self.client.heartbeat, ep, trainer_id=self.trainer_id,
+                    session=self.session, lease_s=self.lease_s)
+                for ep in self.endpoints}
+        ok = 0
+        for ep, f in futs.items():
+            try:
+                f.result()
+                ok += 1
+            except Exception as e:
+                from .. import flags as _flags
+                from ..observe import metrics as _metrics
+                if _flags.get_flag("observe"):
+                    _metrics.counter(
+                        "ark_heartbeat_misses_total",
+                        "heartbeat renewals that failed").inc(endpoint=ep)
+                logger.debug("heartbeat to %s failed: %s", ep, e)
+        return ok
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            self.beat_once()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None

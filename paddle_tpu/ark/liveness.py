@@ -1,0 +1,219 @@
+"""Trainer liveness: heartbeat leases + an evicting sync barrier.
+
+Capability parity rationale: the reference's sync pserver
+(listen_and_serv_op.cc RunSyncLoop) wedges the batch barrier until every
+registered trainer arrives — a dead trainer stalls the world until an RPC
+deadline fires. TensorFlow (Abadi et al., 2016) and every production PS
+design solve this with leases: trainers renew a heartbeat lease, and the
+barrier counts only live leaseholders, degrading gracefully to N-1
+trainers when one dies instead of blocking on `sync_timeout`.
+
+`LeaseTable` is the server-side liveness record; `EvictingBarrier`
+replaces `threading.Barrier` for the sync-apply path — same
+`wait/broken/reset` surface (it raises `threading.BrokenBarrierError` so
+existing recovery code is unchanged) plus `evict`/`readmit`, with party
+membership re-checked while waiting via an `evict_check` callback.
+Trainers that never heartbeat hold no lease and are never evicted: the
+legacy full-party/sync-timeout behavior is preserved for them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Iterable, Optional, Tuple
+
+BrokenBarrierError = threading.BrokenBarrierError
+
+
+class LeaseTable:
+    """Per-trainer heartbeat leases: `beat` renews, `expired` lists
+    leaseholders past their expiry. A trainer is only ever evictable
+    after it has held a lease — unknown trainers are not tracked."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # trainer_id -> (session, expires_at_monotonic, lease_s)
+        self._leases: Dict[int, Tuple[object, float, float]] = {}
+
+    def beat(self, trainer_id: int, session=None,
+             lease_s: float = 3.0) -> None:
+        with self._lock:
+            self._leases[int(trainer_id)] = (
+                session, time.monotonic() + float(lease_s), float(lease_s))
+
+    def session_of(self, trainer_id: int):
+        with self._lock:
+            rec = self._leases.get(int(trainer_id))
+            return rec[0] if rec else None
+
+    def live(self) -> Iterable[int]:
+        now = time.monotonic()
+        with self._lock:
+            return [t for t, (_s, exp, _l) in self._leases.items()
+                    if exp > now]
+
+    def expired(self) -> Iterable[int]:
+        now = time.monotonic()
+        with self._lock:
+            return [t for t, (_s, exp, _l) in self._leases.items()
+                    if exp <= now]
+
+    def forget(self, trainer_id: int) -> None:
+        with self._lock:
+            self._leases.pop(int(trainer_id), None)
+
+    def snapshot(self) -> Dict[int, Dict]:
+        now = time.monotonic()
+        with self._lock:
+            return {t: {"session": s, "lease_s": l,
+                        "expires_in_s": round(exp - now, 3),
+                        "live": exp > now}
+                    for t, (s, exp, l) in self._leases.items()}
+
+
+class EvictingBarrier:
+    """A cyclic barrier over `parties` members whose effective party
+    count shrinks when members are evicted (and grows back on readmit).
+
+    `wait(timeout, evict_check, poll)` blocks until `arrived >= parties -
+    evicted`; while blocked it invokes `evict_check()` every `poll`
+    seconds so the owner can expire leases — an eviction that satisfies
+    the barrier releases the waiters immediately rather than after
+    `timeout`. The completing waiter runs `action` exactly once per
+    generation before any waiter is released (threading.Barrier's action
+    contract). On timeout the barrier breaks for the current generation:
+    all of its waiters raise `threading.BrokenBarrierError` and new
+    arrivals are refused until `reset()`."""
+
+    def __init__(self, parties: int, action: Optional[Callable] = None):
+        # RLock so evict_check callbacks may call evict()/live_parties
+        # re-entrantly from inside wait()
+        self._cond = threading.Condition(threading.RLock())
+        self._full = int(parties)
+        self._action = action
+        self._evicted: set = set()
+        self._arrived = 0
+        # members that identified themselves on arrival this generation:
+        # evicting one of them must DISCOUNT its arrival, or the barrier
+        # would release before the remaining live parties all arrive
+        self._arrived_members: list = []
+        self._gen = 0
+        self._broken = False
+        self._gen_status: Dict[int, str] = {}  # gen -> "done" | "broken"
+
+    @property
+    def parties(self) -> int:
+        return self._full
+
+    @property
+    def live_parties(self) -> int:
+        with self._cond:
+            return self._full - len(self._evicted)
+
+    @property
+    def evicted(self) -> frozenset:
+        with self._cond:
+            return frozenset(self._evicted)
+
+    @property
+    def broken(self) -> bool:
+        with self._cond:
+            return self._broken
+
+    def evict(self, member) -> bool:
+        """Shrink the live party count by `member`; returns True when the
+        eviction is new. If the member already ARRIVED this generation
+        (identified wait), its arrival is discounted too — the shrunken
+        threshold must be met by live arrivals only. Waiters re-check
+        completion immediately."""
+        with self._cond:
+            if member in self._evicted:
+                return False
+            if len(self._evicted) + 1 >= self._full:
+                # never evict the last live party: an all-dead barrier is
+                # a broken barrier, not a 0-party no-op
+                return False
+            self._evicted.add(member)
+            if member in self._arrived_members:
+                self._arrived_members.remove(member)
+                self._arrived -= 1
+            self._cond.notify_all()
+            return True
+
+    def readmit(self, member) -> bool:
+        with self._cond:
+            if member not in self._evicted:
+                return False
+            self._evicted.discard(member)
+            return True
+
+    def reset(self) -> None:
+        """Clear a broken state; evictions persist (the dead stay dead
+        until they heartbeat back in via `readmit`)."""
+        with self._cond:
+            self._broken = False
+            self._arrived = 0
+            self._arrived_members.clear()
+            self._cond.notify_all()
+
+    def _finish(self, gen: int, status: str) -> None:
+        # caller holds the lock
+        self._gen_status[gen] = status
+        while len(self._gen_status) > 64:   # bound: waiters are short-lived
+            self._gen_status.pop(next(iter(self._gen_status)))
+        self._gen += 1
+        self._arrived = 0
+        self._arrived_members.clear()
+        if status == "broken":
+            self._broken = True
+        self._cond.notify_all()
+
+    def wait(self, timeout: Optional[float] = None,
+             evict_check: Optional[Callable] = None,
+             poll: float = 0.1, member=None) -> int:
+        """`member`, when given, identifies this arrival so `evict` can
+        discount it; anonymous arrivals always count toward the
+        threshold (legacy behavior)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            if self._broken:
+                raise BrokenBarrierError
+            gen = self._gen
+            if member is not None and member in self._evicted:
+                # a zombie arrival (evicted member not yet readmitted)
+                # must not count toward the live threshold; it just waits
+                # out the generation
+                pass
+            else:
+                self._arrived += 1
+                if member is not None:
+                    self._arrived_members.append(member)
+            while True:
+                if evict_check is not None:
+                    evict_check()   # may call self.evict() (RLock)
+                status = self._gen_status.get(gen)
+                if status == "done":
+                    return gen
+                if status == "broken":
+                    raise BrokenBarrierError
+                if self._gen == gen and \
+                        self._arrived >= self._full - len(self._evicted):
+                    try:
+                        if self._action is not None:
+                            self._action()
+                    except BaseException:
+                        self._finish(gen, "broken")
+                        raise
+                    self._finish(gen, "done")
+                    return gen
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    self._finish(gen, "broken")
+                    raise BrokenBarrierError
+                slice_ = poll if evict_check is not None else remaining
+                if remaining is not None:
+                    slice_ = remaining if slice_ is None \
+                        else min(slice_, remaining)
+                self._cond.wait(slice_)

@@ -13,10 +13,14 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
+import time
+import uuid
 from typing import List, Optional, Sequence
 
 import numpy as np
 
+from .ark.checkpoint import atomic_file
 from .core import ir
 from .core.executor import Executor, Scope, global_scope
 
@@ -44,6 +48,9 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
     if vars is None:
         vars = _collect(main_program, predicate or _is_persistable)
     os.makedirs(dirname, exist_ok=True)
+    # ark crash safety: every file lands via tmp + os.replace, so a crash
+    # mid-save leaves the previous version (or absence) of each file —
+    # never a torn .npy/.npz that a later load half-reads
     if filename is not None:
         blob = {}
         for v in vars:
@@ -51,14 +58,20 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
             if arr is None:
                 raise RuntimeError(f"variable {v.name} not in scope")
             blob[v.name] = np.asarray(arr)
-        np.savez(os.path.join(dirname, filename), **blob)
+        path = os.path.join(dirname, filename)
+        if not path.endswith(".npz"):
+            path += ".npz"  # np.savez appends it for str paths; file
+            # objects get written as-is, so match the legacy layout
+        with atomic_file(path) as f:
+            np.savez(f, **blob)
     else:
         for v in vars:
             arr = scope.find_var(v.name)
             if arr is None:
                 raise RuntimeError(f"variable {v.name} not in scope")
-            np.save(os.path.join(dirname, v.name + PARAMS_SUFFIX),
-                    np.asarray(arr))
+            with atomic_file(os.path.join(dirname,
+                                          v.name + PARAMS_SUFFIX)) as f:
+                np.save(f, np.asarray(arr))
 
 
 def save_params(executor, dirname, main_program=None, filename=None, scope=None):
@@ -108,9 +121,16 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
                          params_filename=None, scope=None):
     """Prune to the inference slice and persist program+params
-    (reference io.py:551)."""
+    (reference io.py:551).
+
+    ark crash safety: the whole model dir is STAGED in a same-parent tmp
+    dir and swapped in at the end — program json and params commit as one
+    unit, so a crash mid-save never leaves a torn dir mixing a new
+    program with old params (or half the .npy files) that
+    `load_inference_model` would half-load. The previous model dir, when
+    one exists, survives any pre-swap crash."""
     main_program = main_program or ir.default_main_program()
-    os.makedirs(dirname, exist_ok=True)
+    dirname = os.path.abspath(dirname)
     target_names = [v.name if isinstance(v, ir.Variable) else str(v)
                     for v in target_vars]
     pruned = main_program.clone(for_test=True)._prune(target_names)
@@ -119,9 +139,49 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         "feed_names": list(feeded_var_names),
         "fetch_names": target_names,
     }
-    with open(os.path.join(dirname, model_filename or MODEL_FILENAME), "w") as f:
-        json.dump(meta, f)
-    save_persistables(executor, dirname, pruned, params_filename, scope)
+    parent = os.path.dirname(dirname) or "."
+    os.makedirs(parent, exist_ok=True)
+    base = os.path.basename(dirname)
+    # sweep swap leftovers a CRASHED earlier save stranded — but only
+    # old ones: a fresh .stage_/.old_ may belong to a concurrent saver
+    # mid-swap, and deleting its stage (or its rollback copy) would turn
+    # an overlapping save into data loss
+    now = time.time()
+    for name in os.listdir(parent):
+        if name.startswith(f"{base}.old_") or \
+                name.startswith(f".stage_{base}_"):
+            p = os.path.join(parent, name)
+            try:
+                age = now - os.path.getmtime(p)
+            except OSError:
+                continue
+            if age > 3600:
+                shutil.rmtree(p, ignore_errors=True)
+    stage = os.path.join(parent, f".stage_{base}_{uuid.uuid4().hex}")
+    os.makedirs(stage)
+    try:
+        with open(os.path.join(stage, model_filename or MODEL_FILENAME),
+                  "w") as f:
+            json.dump(meta, f)
+        save_persistables(executor, stage, pruned, params_filename, scope)
+        if os.path.isdir(dirname):
+            # swap: retire the old dir by rename (fast), bring the stage
+            # in, then delete the retired copy. If the swap-in fails the
+            # old dir is rolled back, so dirname is absent only across a
+            # hard crash inside this window — never torn.
+            old = dirname + f".old_{uuid.uuid4().hex}"
+            os.rename(dirname, old)
+            try:
+                os.rename(stage, dirname)
+            except BaseException:
+                os.rename(old, dirname)   # roll the previous model back
+                raise
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.rename(stage, dirname)
+    except BaseException:
+        shutil.rmtree(stage, ignore_errors=True)
+        raise
     return target_names
 
 

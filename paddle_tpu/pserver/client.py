@@ -20,6 +20,7 @@ host boundary where TPUs require it.
 
 from __future__ import annotations
 
+import socket as _socket
 import threading
 import time
 from typing import Dict, List, Optional, Sequence
@@ -27,15 +28,33 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from .. import flags as _flags
+from ..ark.retry import RetryPolicy
 from ..observe import metrics as _metrics
 from . import rpc
 
 
 class PSClient:
-    """Connection pool + typed calls to a set of parameter servers."""
+    """Connection pool + typed calls to a set of parameter servers.
 
-    def __init__(self, endpoints: Sequence[str]):
+    Fault tolerance (ark): every call rides a bounded exponential-backoff
+    retry loop (`retry=RetryPolicy(...)`, jittered; `ark.NO_RETRY`
+    restores fail-fast), honors an optional per-call wall `deadline`
+    (seconds; None keeps the legacy block-forever behavior needed by the
+    sync barrier), transparently reconnects sockets that went stale
+    across a pserver restart, and — for read-only commands — fails over
+    to replica endpoints (`replicas={primary: [backup, ...]}`) when the
+    primary is gone."""
+
+    def __init__(self, endpoints: Sequence[str],
+                 retry: Optional[RetryPolicy] = None,
+                 deadline: Optional[float] = None,
+                 replicas: Optional[Dict[str, Sequence[str]]] = None):
         self.endpoints = list(endpoints)
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.deadline = deadline if deadline is not None \
+            else self.retry.deadline
+        self.replicas = {ep: list(reps)
+                         for ep, reps in (replicas or {}).items()}
         self._socks = {}
         self._lock = threading.Lock()
         self._ep_locks: Dict[str, threading.Lock] = {}
@@ -45,55 +64,114 @@ class PSClient:
             max_workers=max(1, len(self.endpoints)),
             thread_name_prefix="psclient")
 
-    def _sock(self, endpoint):
+    def _drop_sock(self, endpoint):
         with self._lock:
-            if endpoint not in self._socks:
-                self._socks[endpoint] = rpc.connect(endpoint)
-            return self._socks[endpoint]
+            old = self._socks.pop(endpoint, None)
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
 
-    # RPCs safe to replay on a dropped connection: reads and first-wins
-    # initialization. Mutating commands (push_grad, batch_barrier, ...)
-    # are NOT replayed — the drop may have happened after the server
-    # applied the request, and a duplicate grad push double-steps the
-    # param while a duplicate barrier arrival releases it early.
-    _IDEMPOTENT = frozenset({"get_param", "get_params", "prefetch_rows",
-                             "init_param", "init_table"})
+    @staticmethod
+    def _stale(sock) -> bool:
+        """A cached socket whose peer restarted delivers EOF/RST on next
+        use; probe with a non-blocking MSG_PEEK so the reconnect happens
+        BEFORE the request is sent — otherwise a non-replayable command
+        is poisoned by a server that never saw it. The protocol is
+        strict request/reply, so any readable byte here is itself a
+        desync; only BlockingIOError (nothing to read) means healthy."""
+        try:
+            sock.setblocking(False)
+            try:
+                return sock.recv(1, _socket.MSG_PEEK) is not None
+            finally:
+                sock.setblocking(True)
+        except (BlockingIOError, InterruptedError):
+            try:
+                sock.setblocking(True)
+            except OSError:
+                return True
+            return False
+        except OSError:
+            return True
 
-    def _call(self, endpoint, cmd, **payload):
+    def _sock(self, endpoint, connect_timeout=None):
+        with self._lock:
+            sock = self._socks.get(endpoint)
+        if sock is not None and self._stale(sock):
+            self._drop_sock(endpoint)
+            sock = None
+        if sock is None:
+            sock = rpc.connect(endpoint,
+                               timeout=(connect_timeout
+                                        if connect_timeout is not None
+                                        else 30.0))
+            with self._lock:
+                self._socks[endpoint] = sock
+        return sock
+
+    # RPCs safe to REPLAY after the request may have reached the server:
+    # reads, first-wins initialization, and batch-id-tagged sync pushes
+    # (the server's (trainer, batch, session) watermark acknowledges a
+    # duplicate without re-accumulating). Other mutating commands
+    # (push_grad, sync_apply, batch_barrier ...) are never replayed past
+    # a fully-sent request — a duplicate grad push double-steps the param
+    # and a duplicate barrier arrival releases it early. They DO retry
+    # send-phase failures: the frame is length-prefixed, so a request
+    # whose send failed was never dispatched by the server.
+    _IDEMPOTENT = frozenset({"get_param", "get_params", "prefetch",
+                             "init_param", "init_table", "stats",
+                             "heartbeat", "save", "restore"})
+
+    # strictly read-only commands: the ONLY ones allowed to fail over to
+    # a replica endpoint. Idempotent-but-mutating commands (save,
+    # init_param, ...) must not — a `save` answered by a replica would
+    # commit the WRONG shard into a checkpoint that verifies clean, and
+    # a heartbeat lease belongs to one specific server.
+    _READ_ONLY = frozenset({"get_param", "get_params", "prefetch",
+                            "stats"})
+
+    @classmethod
+    def _replayable(cls, cmd, payload) -> bool:
+        if cmd in cls._IDEMPOTENT:
+            return True
+        return cmd == "push_grads_sync" and \
+            payload.get("batch_id") is not None
+
+    # commands that legitimately block for a long time (barriers): a
+    # default deadline would break them, so only an explicit per-call
+    # deadline applies
+    _NO_DEFAULT_DEADLINE = frozenset({"sync_apply", "batch_barrier"})
+
+    def _call(self, endpoint, cmd, _deadline=..., **payload):
+        """One RPC with retry/backoff/deadline; `_deadline=...` (unset)
+        follows the client default, None disables, a float overrides."""
+        if _deadline is ...:
+            _deadline = (None if cmd in self._NO_DEFAULT_DEADLINE
+                         else self.deadline)
         obs = _flags.get_flag("observe")
         t0 = time.perf_counter() if obs else 0.0
-        tx = rx = 0
-        with self._lock:
-            ep_lock = self._ep_locks.setdefault(endpoint, threading.Lock())
-        with ep_lock:  # one in-flight request per connection
+        candidates = [endpoint]
+        if cmd in self._READ_ONLY:
+            candidates += [ep for ep in self.replicas.get(endpoint, ())
+                           if ep != endpoint]
+        last_err = None
+        for i, ep in enumerate(candidates):
             try:
-                sock = self._sock(endpoint)
-                tx = rpc.send_msg(sock, (cmd, payload))
-                (status, value), rx = rpc.recv_msg(sock, with_size=True)
-            except (ConnectionError, EOFError, OSError):
-                if cmd not in self._IDEMPOTENT:
-                    if obs:
-                        _metrics.counter(
-                            "pserver_client_errors_total",
-                            "client RPCs failed without retry").inc(cmd=cmd)
-                    raise
-                # transparent one-shot reconnect for idempotent RPCs, as
-                # the reference's gRPC channel re-dials dropped channels
-                if obs:
+                (status, value), tx, rx = self._call_one(
+                    ep, cmd, payload, _deadline, obs)
+                break
+            except (ConnectionError, EOFError, OSError) as e:
+                last_err = e
+                if i + 1 < len(candidates) and obs:
                     _metrics.counter(
-                        "pserver_client_retries_total",
-                        "idempotent RPCs replayed after a dropped "
-                        "connection").inc(cmd=cmd)
-                with self._lock:
-                    old = self._socks.pop(endpoint, None)
-                if old is not None:
-                    try:
-                        old.close()
-                    except OSError:
-                        pass
-                sock = self._sock(endpoint)
-                tx = rpc.send_msg(sock, (cmd, payload))
-                (status, value), rx = rpc.recv_msg(sock, with_size=True)
+                        "pserver_client_failovers_total",
+                        "reads rerouted to a replica endpoint").inc(
+                            cmd=cmd, frm=ep)
+                continue
+        else:
+            raise last_err
         if obs:
             _metrics.counter(
                 "pserver_client_requests_total",
@@ -111,6 +189,70 @@ class PSClient:
         if status != "ok":
             raise RuntimeError(f"pserver {endpoint} {cmd}: {value}")
         return value
+
+    def _call_one(self, endpoint, cmd, payload, deadline, obs):
+        """The per-endpoint retry loop. Failure phases:
+
+        - connect/send: the length-prefixed frame never reached the
+          server complete, so it was never dispatched — ANY command is
+          safe to retry;
+        - recv (incl. a deadline timeout): the server may have applied
+          the request — only replayable commands retry.
+        """
+        policy = self.retry
+        replay_ok = self._replayable(cmd, payload)
+        deadline_at = None if deadline is None \
+            else time.monotonic() + deadline
+        with self._lock:
+            ep_lock = self._ep_locks.setdefault(endpoint, threading.Lock())
+        attempt = 0
+        with ep_lock:  # one in-flight request per connection
+            while True:
+                phase = "connect"
+                try:
+                    # the connect itself honors the remaining deadline:
+                    # rpc.connect's default 30 s would otherwise wedge a
+                    # short-deadline call (heartbeats!) on a blackholed
+                    # endpoint for 30 s per attempt
+                    remaining = None if deadline_at is None else \
+                        max(0.01, deadline_at - time.monotonic())
+                    sock = self._sock(endpoint, connect_timeout=remaining)
+                    if deadline_at is not None:
+                        sock.settimeout(
+                            max(0.01, deadline_at - time.monotonic()))
+                    phase = "send"
+                    tx = rpc.send_msg(sock, (cmd, payload))
+                    phase = "recv"
+                    reply, rx = rpc.recv_msg(sock, with_size=True)
+                    if deadline_at is not None:
+                        sock.settimeout(None)
+                    return reply, tx, rx
+                except (ConnectionError, EOFError, OSError):
+                    self._drop_sock(endpoint)
+                    safe = phase != "recv" or replay_ok
+                    out_of_time = deadline_at is not None and \
+                        time.monotonic() >= deadline_at
+                    if not safe or attempt >= policy.max_attempts \
+                            or out_of_time:
+                        if obs:
+                            _metrics.counter(
+                                "pserver_client_gave_up_total",
+                                "RPCs abandoned after exhausting retries "
+                                "(or unsafe to replay)").inc(
+                                    cmd=cmd, phase=phase)
+                        raise
+                    if obs:
+                        _metrics.counter(
+                            "pserver_client_retries_total",
+                            "RPC attempts replayed after a transport "
+                            "failure").inc(cmd=cmd, phase=phase)
+                    delay = policy.backoff(attempt)
+                    attempt += 1
+                    if deadline_at is not None:
+                        delay = min(delay,
+                                    max(0.0, deadline_at - time.monotonic()))
+                    if delay:
+                        time.sleep(delay)
 
     # -- dense ------------------------------------------------------------
     def init_param(self, endpoint, name, value, opt_type, lr, attrs):
@@ -210,13 +352,29 @@ class PSClient:
                             "session": session})
                       for ep, grads in by_ep.items()})
 
-    def sync_apply(self, endpoints: Sequence[str]):
+    def sync_apply(self, endpoints: Sequence[str],
+                   trainer_id: Optional[int] = None):
         """Per-batch barrier on every server: blocks until ALL trainers
         have pushed and the aggregated update is applied (reference
-        batch-barrier + optimize blocks, then kRequestGet unblocks)."""
-        self._fanout("sync_apply", {ep: {} for ep in endpoints})
+        batch-barrier + optimize blocks, then kRequestGet unblocks).
+        `trainer_id` identifies this arrival to the evicting barrier so
+        a later eviction of THIS trainer discounts its arrival (ark
+        liveness); untagged arrivals keep the legacy anonymous count."""
+        payload = {} if trainer_id is None else \
+            {"trainer_id": int(trainer_id)}
+        self._fanout("sync_apply", {ep: dict(payload) for ep in endpoints})
 
     # -- control ------------------------------------------------------------
+    def heartbeat(self, endpoint, trainer_id, session=None,
+                  lease_s: float = 3.0):
+        """Renew this trainer's liveness lease on `endpoint` (ark).
+        Short deadline: a wedged server must not wedge the heartbeat
+        loop — the whole point is detecting exactly that."""
+        return self._call(endpoint, "heartbeat",
+                          _deadline=min(lease_s, 2.0),
+                          trainer_id=int(trainer_id), session=session,
+                          lease_s=float(lease_s))
+
     def barrier(self):
         for ep in self.endpoints:
             self._call(ep, "batch_barrier")

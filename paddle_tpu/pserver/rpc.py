@@ -24,6 +24,38 @@ from typing import Any, Tuple
 
 _HDR = struct.Struct("!Q")  # 8-byte big-endian length prefix
 
+
+class RPCConnectionError(ConnectionError):
+    """The peer closed or reset the connection mid-frame. Carries the
+    endpoint and the read progress so a half-delivered message surfaces
+    as a diagnosable transport failure, not a bare struct.error or
+    short-read EOFError (reference grpc_client.cc surfaces the endpoint
+    in every failed-RPC log line for the same reason)."""
+
+
+def _peer_of(sock: socket.socket) -> str:
+    try:
+        host, port = sock.getpeername()[:2]
+        return f"{host}:{port}"
+    except OSError:
+        return "<disconnected>"
+
+
+# test-only fault injection point (ark/chaos.py). The hook receives
+# (direction, sock, wire_bytes_or_None) and returns the bytes to send
+# (possibly delayed/modified), or None when it consumed or discarded the
+# message itself. None hook (default) costs one attribute read per call.
+_fault_hook = None
+
+
+def set_fault_hook(fn) -> None:
+    global _fault_hook
+    _fault_hook = fn
+
+
+def get_fault_hook():
+    return _fault_hook
+
 # Trust boundary: like the reference's INSECURE gRPC channels
 # (grpc_client.cc creates no credentials), this transport assumes a trusted
 # cluster network. Defense in depth: deserialization goes through a
@@ -55,7 +87,12 @@ def send_msg(sock: socket.socket, obj: Any) -> int:
     """Send one length-prefixed message; returns the wire byte count so
     observing callers can account traffic without re-serializing."""
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_HDR.pack(len(payload)) + payload)
+    data = _HDR.pack(len(payload)) + payload
+    if _fault_hook is not None:
+        data = _fault_hook("send", sock, data)
+        if data is None:   # injected drop/truncate consumed the message
+            return _HDR.size + len(payload)
+    sock.sendall(data)
     return _HDR.size + len(payload)
 
 
@@ -63,21 +100,26 @@ def recv_msg(sock: socket.socket, with_size: bool = False) -> Any:
     """Receive one message. `with_size=True` returns (obj, wire_bytes)
     for telemetry callers; the default keeps the legacy single-value
     return."""
-    header = _recv_exact(sock, _HDR.size)
+    if _fault_hook is not None:
+        _fault_hook("recv", sock, None)
+    header = _recv_exact(sock, _HDR.size, what="header")
     (n,) = _HDR.unpack(header)
-    obj = _RestrictedUnpickler(io.BytesIO(_recv_exact(sock, n))).load()
+    obj = _RestrictedUnpickler(
+        io.BytesIO(_recv_exact(sock, n, what="payload"))).load()
     if with_size:
         return obj, _HDR.size + n
     return obj
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_exact(sock: socket.socket, n: int, what: str = "message") -> bytes:
     chunks = []
     got = 0
     while got < n:
         chunk = sock.recv(min(n - got, 1 << 20))
         if not chunk:
-            raise ConnectionError("peer closed connection mid-message")
+            raise RPCConnectionError(
+                f"peer {_peer_of(sock)} closed connection mid-{what}: "
+                f"got {got}/{n} bytes")
         chunks.append(chunk)
         got += len(chunk)
     return b"".join(chunks)

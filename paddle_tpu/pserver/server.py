@@ -22,6 +22,7 @@ from __future__ import annotations
 import logging
 import os
 import socket
+import struct
 import threading
 import time
 from typing import Dict, Optional
@@ -29,6 +30,8 @@ from typing import Dict, Optional
 import numpy as np
 
 from .. import flags as _flags
+from ..ark import checkpoint as ark_ckpt
+from ..ark.liveness import EvictingBarrier, LeaseTable
 from ..observe import metrics as _metrics
 from . import rpc
 from .optim import make_optimizer
@@ -84,13 +87,21 @@ class ParameterServer:
         self._sync_applied: Dict[int, int] = {}     # trainer -> batch id
         self._sync_sessions: Dict[int, object] = {}  # trainer -> nonce
         self._sync_pending_from: set = set()
-        self._sync_barrier = threading.Barrier(trainers,
-                                               action=self._apply_pending)
+        # liveness (ark): heartbeat leases + an evicting barrier — a dead
+        # leaseholder is evicted once its lease expires, degrading the
+        # sync world to N-1 instead of wedging until sync_timeout.
+        # Trainers that never heartbeat hold no lease and keep the legacy
+        # full-party/sync-timeout behavior.
+        self._lease = LeaseTable()
+        self._sync_barrier = EvictingBarrier(trainers,
+                                             action=self._apply_pending)
         self._locks: Dict[str, threading.Lock] = {}
         self._global_lock = threading.Lock()
         self._barrier = threading.Barrier(trainers) if trainers > 1 else None
         self._listener: Optional[socket.socket] = None
         self._threads = []
+        self._conns: set = set()   # live accepted sockets (for hard cut)
+        self._conns_lock = threading.Lock()
         self._stop = threading.Event()
 
     # -- lifecycle --------------------------------------------------------
@@ -114,12 +125,39 @@ class ParameterServer:
         self._stop.wait()
 
     def stop(self):
+        """Hard cut, like a killed process: the listener AND every live
+        connection close immediately (in-flight requests are dropped
+        unanswered, waiting clients see EOF/RST), and the endpoint's
+        port frees up so a restarted server can bind it."""
         self._stop.set()
         if self._listener is not None:
+            # shutdown BEFORE close: the accept-loop thread blocked in
+            # accept() holds a kernel reference — close() alone leaves
+            # the port in LISTEN until that accept returns
+            for f in ("shutdown", "close"):
+                try:
+                    (self._listener.shutdown(socket.SHUT_RDWR)
+                     if f == "shutdown" else self._listener.close())
+                except OSError:
+                    pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
             try:
-                self._listener.close()
+                # linger-0 + shutdown + close: the RST close (not a FIN
+                # close that parks the port in FIN_WAIT_2 for 60s) and
+                # the shutdown wakes the conn thread blocked in recv so
+                # the socket actually dies now
+                c.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                             struct.pack("ii", 1, 0))
             except OSError:
                 pass
+            for f in ("shutdown", "close"):
+                try:
+                    (c.shutdown(socket.SHUT_RDWR) if f == "shutdown"
+                     else c.close())
+                except OSError:
+                    pass
 
     def _accept_loop(self):
         while not self._stop.is_set():
@@ -128,11 +166,16 @@ class ParameterServer:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                self._conns.add(conn)
             # connection threads are daemonic and untracked (tracking them
             # would leak one Thread object per reconnect on a long-lived
-            # server)
+            # server); the SOCKETS are tracked so stop() can hard-cut
+            # them. The psconn@ name is load-bearing: ark's chaos
+            # injector keys client-vs-server fault targeting on it.
             threading.Thread(target=self._serve_conn, args=(conn,),
-                             daemon=True).start()
+                             daemon=True,
+                             name=f"psconn@{self.endpoint}").start()
 
     def _serve_conn(self, conn):
         try:
@@ -181,6 +224,8 @@ class ParameterServer:
                 if cmd == "stop":
                     return
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             conn.close()
 
     # -- dispatch ---------------------------------------------------------
@@ -318,17 +363,64 @@ class ParameterServer:
         ParallelExecutor CoeffNumDevice convention)."""
         with self._pending_lock:
             pending, self._pending = self._pending, {}
+            # distinct trainers whose gradients are actually summed into
+            # this batch — the correct mean divisor. A trainer that
+            # PUSHED and then died before the barrier still contributed;
+            # dividing by the (smaller) live count would over-weight the
+            # update by N/(N-1). Untagged legacy pushes leave no keys —
+            # fall back to the live party count there.
+            contributors = {t for t, _b in self._sync_pending_from}
             for t, b in self._sync_pending_from:
                 if b > self._sync_applied.get(t, -1):
                     self._sync_applied[t] = b
             self._sync_pending_from.clear()
+        n_contrib = len(contributors) or self._sync_barrier.live_parties
         for n, g in pending.items():
             with self._lock(n):
-                self._optim[n].dense(self._dense[n], g / self.trainers)
+                self._optim[n].dense(self._dense[n],
+                                     g / max(n_contrib, 1))
 
-    def _h_sync_apply(self):
+    # -- liveness (ark): heartbeat leases + eviction -----------------------
+    def _h_heartbeat(self, trainer_id, session=None, lease_s=3.0):
+        """Renew `trainer_id`'s liveness lease. A previously-evicted
+        trainer that heartbeats again (a restart rejoining) is
+        readmitted — the barrier's party count grows back and its fresh
+        session nonce resets its sync watermark on first push."""
+        self._lease.beat(trainer_id, session=session, lease_s=lease_s)
+        if self._sync_barrier.readmit(trainer_id):
+            logger.info("pserver %s: trainer %s readmitted after "
+                        "heartbeat (lease %.1fs)", self.endpoint,
+                        trainer_id, lease_s)
+            if _flags.get_flag("observe"):
+                _metrics.counter(
+                    "pserver_trainers_readmitted_total",
+                    "evicted trainers readmitted after a fresh "
+                    "heartbeat").inc()
+        return ("ok", {"live_trainers": self._sync_barrier.live_parties,
+                       "leases": self._lease.snapshot()})
+
+    def _evict_expired(self):
+        """Barrier-wait callback: evict leaseholders whose lease expired
+        so the sync world degrades to the live N-1 instead of wedging
+        until sync_timeout. Only ever called while some trainer is
+        waiting — an idle server expires no one."""
+        for tid in self._lease.expired():
+            if self._sync_barrier.evict(tid):
+                logger.warning(
+                    "pserver %s: trainer %s lease expired — evicted from "
+                    "the sync barrier (world degrades to %d live "
+                    "trainers)", self.endpoint, tid,
+                    self._sync_barrier.live_parties)
+                if _flags.get_flag("observe"):
+                    _metrics.counter(
+                        "pserver_trainers_evicted_total",
+                        "trainers evicted on lease expiry").inc()
+
+    def _h_sync_apply(self, trainer_id=None):
         try:
-            self._sync_barrier.wait(timeout=self.sync_timeout)
+            self._sync_barrier.wait(timeout=self.sync_timeout,
+                                    evict_check=self._evict_expired,
+                                    member=trainer_id)
         except threading.BrokenBarrierError:
             # recover rather than poison the long-lived server: the FIRST
             # recovering thread (the one that still observes the barrier
@@ -360,7 +452,15 @@ class ParameterServer:
         """Snapshot values AND optimizer state (accumulators + config) so
         a crashed server can be restarted from its shard and training
         resumes with identical update dynamics (reference checkpoint_notify
-        -> save block on the pserver, request_handler_impl.cc)."""
+        -> save block on the pserver, request_handler_impl.cc).
+
+        Joins the ark atomic/manifest protocol: the npz lands via tmp +
+        os.replace (a crash mid-save never tears an existing shard) and a
+        sha256 sidecar manifest commits after it, so `recover()` and
+        `ark.verify_checkpoint` can prove the shard intact. When
+        `dirname` is a checkpoint stage dir (trainer-driven
+        `save_checkpoint(shard_saver=...)`), the shard commits as part of
+        the same all-or-nothing serial."""
         import json
 
         os.makedirs(dirname, exist_ok=True)
@@ -386,7 +486,10 @@ class ParameterServer:
                 meta[n] = {"kind": kind, "opt_type": opt_type,
                            "lr": st["lr"], "attrs": st["attrs"]}
         path = self._shard_path(dirname)
-        np.savez(path, __meta__=np.array(json.dumps(meta)), **arrays)
+        with ark_ckpt.atomic_file(path) as f:
+            np.savez(f, __meta__=np.array(json.dumps(meta)), **arrays)
+        ark_ckpt.write_sidecar_manifest(path, endpoint=self.endpoint,
+                                        kind="pserver_shard")
         return ("ok", path)
 
     def recover(self, dirname) -> "ParameterServer":
@@ -398,6 +501,10 @@ class ParameterServer:
         import json
 
         path = self._shard_path(dirname)
+        # checksum gate BEFORE deserializing: a torn/bit-rotted shard is
+        # refused loudly, never half-loaded (no sidecar = pre-ark shard,
+        # loaded as before)
+        ark_ckpt.verify_sidecar(path)
         with np.load(path, allow_pickle=False) as z:
             meta = json.loads(str(z["__meta__"]))
             for name, m in meta.items():

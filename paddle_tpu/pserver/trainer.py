@@ -218,7 +218,8 @@ class SyncPSTrainer(AsyncPSTrainer):
     execution-mode parity and for host-only deployments.
     """
 
-    def __init__(self, transpiler, exe, program=None, scope=None):
+    def __init__(self, transpiler, exe, program=None, scope=None,
+                 heartbeat_lease_s=None):
         super().__init__(transpiler, exe, program=program, scope=scope)
         if transpiler.sparse_specs:
             raise NotImplementedError(
@@ -233,6 +234,35 @@ class SyncPSTrainer(AsyncPSTrainer):
         import uuid
         self._batch_id = 0
         self._session = uuid.uuid4().hex
+        # liveness lease (ark, OPT-IN): with a lease, this trainer's death
+        # is detected by lease expiry and the servers' sync barrier
+        # degrades to N-1 live trainers instead of wedging until
+        # sync_timeout. Without one (default), the trainer is unknown to
+        # the lease table and the legacy full-party behavior holds.
+        self._heartbeat = None
+        self._hb_client = None
+        if heartbeat_lease_s is not None:
+            from ..ark.heartbeat import HeartbeatThread
+            # DEDICATED client: heartbeats must never contend with the
+            # blocking sync-barrier RPC for the shared per-endpoint
+            # connection, or a slow batch (longer than the lease) would
+            # starve renewals and get this live trainer evicted
+            self._hb_client = PSClient(transpiler._pserver_endpoints)
+            self._heartbeat = HeartbeatThread(
+                self._hb_client, transpiler._pserver_endpoints,
+                trainer_id=self.trainer_id, session=self._session,
+                lease_s=heartbeat_lease_s)
+            # synchronous first beat: the lease must exist before the
+            # first sync barrier so eviction semantics apply from step 0
+            self._heartbeat.beat_once()
+            self._heartbeat.start()
+
+    def close(self):
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
+        if self._hb_client is not None:
+            self._hb_client.close()
+        super().close()
 
     def step(self, feed: Dict, fetch_list: Sequence) -> List[np.ndarray]:
         # 1. recv: params as of the LAST barrier (identical on every
@@ -257,8 +287,11 @@ class SyncPSTrainer(AsyncPSTrainer):
 
         # 4. ... then the per-batch barrier on EVERY server (each counts
         # all trainers); returning means the aggregated update is applied.
-        # Only a successful apply advances the batch id: a barrier error
-        # propagates and the user's retry re-runs THIS batch id.
-        self.client.sync_apply(self.t._pserver_endpoints)
+        # The arrival is tagged with this trainer's id so an eviction of
+        # THIS trainer discounts it (ark liveness). Only a successful
+        # apply advances the batch id: a barrier error propagates and the
+        # user's retry re-runs THIS batch id.
+        self.client.sync_apply(self.t._pserver_endpoints,
+                               trainer_id=self.trainer_id)
         self._batch_id += 1
         return user_outs

@@ -17,6 +17,7 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from . import ark as _ark
 from . import flags as _flags
 from . import io as fluid_io
 from .observe import metrics as _obs_metrics
@@ -211,19 +212,95 @@ class Trainer:
             self._prepared[key] = hit
         return hit[0].run(feed)
 
+    # -- ark durable checkpoints (fluid-ark) ------------------------------
+    def _ark_state(self):
+        """(arrays, rng) for an ark checkpoint: every persistable var of
+        the train program — parameters AND optimizer slot vars — plus the
+        executor PRNG stream state (the per-program run counter that
+        derives each step's fold_in key, and the unseeded-stream
+        ordinal), so a resumed run draws the SAME per-step keys the
+        uninterrupted run would have."""
+        arrays = {}
+        for v in fluid_io._collect(self.train_program,
+                                   fluid_io._is_persistable):
+            val = self.scope.find_var(v.name)
+            if val is not None:
+                arrays[v.name] = np.asarray(val)
+        uid = self.train_program._uid
+        rng = {"train_runs": int(self.exe._run_counts.get(uid, 0)),
+               "stream": int(self.exe._prog_order.get(uid, -1))}
+        return arrays, rng
+
+    def _ark_restore(self, arrays, manifest):
+        for v in fluid_io._collect(self.train_program,
+                                   fluid_io._is_persistable):
+            if v.name in arrays:
+                self.scope.set_var(v.name, arrays[v.name])
+        rng = manifest.get("rng", {})
+        uid = self.train_program._uid
+        if "train_runs" in rng:
+            self.exe._run_counts[uid] = int(rng["train_runs"])
+        stream = int(rng.get("stream", -1))
+        if stream >= 0:
+            # the rebuilt program gets the ORIGINAL run's stream ordinal
+            # (unseeded-program PRNG keys mix it in); keep the monotone
+            # source ahead so no later program collides with it
+            self.exe._prog_order[uid] = stream
+            self.exe._next_stream = max(self.exe._next_stream, stream + 1)
+
+    def _ark_save(self, cfg, epoch_id, step_id, step_in_epoch):
+        arrays, rng = self._ark_state()
+        return _ark.save_checkpoint(
+            cfg.checkpoint_dir, arrays,
+            cursor={"epoch_id": int(epoch_id), "step_id": int(step_id),
+                    "step_in_epoch": int(step_in_epoch)},
+            rng=rng, max_num_checkpoints=cfg.max_num_checkpoints)
+
     def train(self, num_epochs, event_handler=None, reader=None,
-              feed_order=None):
+              feed_order=None, checkpoint=None):
+        """`checkpoint=ark.CheckpointConfig(...)` turns on durable
+        auto-checkpointing: the newest intact serial is restored before
+        the first step (params + optimizer slots + RNG cursors; already-
+        consumed batches of the resume epoch are skipped, so with a
+        deterministic reader the resumed run's fetches are bit-identical
+        to the uninterrupted run), and a new serial commits atomically
+        every `step_interval` steps / `epoch_interval` epochs with
+        retained-N rotation. The legacy `checkpoint_config` constructor
+        path is unchanged."""
         event_handler = event_handler or (lambda e: None)
         feeder = DataFeeder(feed_order, program=self.train_program)
+        if checkpoint is not None and \
+                not isinstance(checkpoint, _ark.CheckpointConfig):
+            raise TypeError(
+                f"checkpoint= takes an ark.CheckpointConfig, got "
+                f"{type(checkpoint).__name__} (the legacy "
+                f"trainer.CheckpointConfig goes to Trainer("
+                f"checkpoint_config=...))")
+        ark_cfg = checkpoint
         # resume the global step counter from the restored checkpoint so the
         # save cadence and trainer_args don't regress after a restart
         step = self.checkpoint_cfg.step_id if self.checkpoint_cfg else 0
         start_epoch = self.checkpoint_cfg.epoch_id if self.checkpoint_cfg else 0
+        skip_in_epoch = 0
+        if ark_cfg is not None:
+            latest = _ark.latest_checkpoint(ark_cfg.checkpoint_dir,
+                                            verify=ark_cfg.verify_on_load)
+            if latest is not None:
+                # checksums already verified picking `latest`
+                arrays, manifest = _ark.load_checkpoint(latest, verify=False)
+                self._ark_restore(arrays, manifest)
+                cursor = manifest.get("cursor", {})
+                start_epoch = int(cursor.get("epoch_id", 0))
+                step = int(cursor.get("step_id", 0))
+                skip_in_epoch = int(cursor.get("step_in_epoch", 0))
         for epoch in range(start_epoch, num_epochs):
             event_handler(BeginEpochEvent(epoch))
             epoch_ts, epoch_t0 = time.time(), time.perf_counter()
             epoch_start_step = step
-            for batch in reader():
+            skip = skip_in_epoch if epoch == start_epoch else 0
+            for batch_idx, batch in enumerate(reader()):
+                if batch_idx < skip:
+                    continue   # replayed by the reader, consumed pre-crash
                 begin = BeginStepEvent(epoch, step)
                 event_handler(begin)
                 fetch = [self.loss] + self.metrics if begin.fetch_metrics else []
@@ -231,6 +308,9 @@ class Trainer:
                 event_handler(EndStepEvent(epoch, step,
                                            [np.asarray(o) for o in out]))
                 step += 1
+                if ark_cfg is not None and \
+                        step % ark_cfg.step_interval == 0:
+                    self._ark_save(ark_cfg, epoch, step, batch_idx + 1)
                 if self.checkpoint_cfg and \
                         step % self.checkpoint_cfg.step_interval == 0:
                     save_checkpoint(
@@ -256,6 +336,10 @@ class Trainer:
                     "epoch", epoch_ts, dur, cat="trainer", epoch=epoch,
                     steps=n_steps,
                     steps_per_sec=round(n_steps / dur, 3) if dur else 0.0)
+            if ark_cfg is not None and \
+                    (epoch + 1) % ark_cfg.epoch_interval == 0:
+                # epoch-boundary serial: cursor points AT the next epoch
+                self._ark_save(ark_cfg, epoch + 1, step, 0)
             event_handler(EndEpochEvent(epoch))
 
     def test(self, reader, feed_order):

@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""chaos_drill: run a small distributed training job under a named fault
+scenario and exit nonzero unless recovery succeeds.
+
+    python tools/chaos_drill.py --scenario pserver_kill [--seed 7]
+
+Scenarios (all seed-deterministic through ark.chaos):
+
+    flaky_rpc     connections randomly die and stall under the trainer;
+                  PASS = training completes, converges, and the retry
+                  counters show the client actually recovered
+    pserver_kill  SIGKILL-equivalent pserver death mid-run; PASS = the
+                  restarted server recovers its atomic shard checkpoint
+                  and the run finishes inside the no-fault loss band
+    ckpt_crash    a crash is injected mid-`save_checkpoint` (the commit
+                  rename never happens); PASS = the previous serial
+                  loads intact (manifest checksums verify) and a fresh
+                  trainer auto-resumes bit-identically
+    sync_evict    a sync trainer dies holding a heartbeat lease; PASS =
+                  the barrier evicts it in lease-time (not sync_timeout)
+                  and the surviving trainer's update applies once
+
+The CI wrapper (`tests/test_fault_tolerance.py::test_chaos_drill_cli`)
+is marked `slow`, so tier-1 wall time is unaffected; run the drills
+explicitly with `pytest -m slow tests/test_fault_tolerance.py` or this
+CLI.
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu import ark, layers  # noqa: E402
+from paddle_tpu.ark import chaos  # noqa: E402
+from paddle_tpu.observe import metrics as obs_metrics  # noqa: E402
+from paddle_tpu.pserver import (AsyncPSTrainer, ParameterServer,  # noqa: E402
+                                PSClient)
+
+
+class DrillFailure(Exception):
+    pass
+
+
+def _check(ok, what):
+    status = "ok" if ok else "FAIL"
+    print(f"  [{status}] {what}")
+    if not ok:
+        raise DrillFailure(what)
+
+
+def _fresh_world(seed, n_servers=2, lr=0.1):
+    servers = [ParameterServer("127.0.0.1:0").start()
+               for _ in range(n_servers)]
+    eps = ",".join(s.endpoint for s in servers)
+    np.random.seed(seed)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="int64")
+        h = layers.fc(input=x, size=16, act="relu")
+        logits = layers.fc(input=h, size=2, act=None)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    main.random_seed = startup.random_seed = seed
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers=eps, trainers=1,
+                sync_mode=False)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    tr = AsyncPSTrainer(t, exe, program=main, scope=scope)
+    tr.init_params()
+    rng = np.random.RandomState(seed + 1)
+    w_true = rng.randn(8, 2).astype(np.float32)
+
+    def batch(n=32):
+        xs = rng.randn(n, 8).astype(np.float32)
+        ys = (xs @ w_true).argmax(1).astype(np.int64).reshape(n, 1)
+        return {"x": xs, "y": ys}
+
+    return servers, tr, loss, batch
+
+
+def _run_steps(tr, loss, batch, n):
+    out = []
+    for _ in range(n):
+        l, = tr.step(batch(), fetch_list=[loss])
+        out.append(float(np.asarray(l).reshape(-1)[0]))
+    return out
+
+
+def drill_flaky_rpc(seed, workdir):
+    fluid.set_flag("observe", True)
+    obs_metrics.default_registry().reset()
+    servers, tr, loss, batch = _fresh_world(seed)
+    try:
+        with chaos.ChaosMonkey(seed=seed, p_close=0.06, p_delay=0.06,
+                               delay_s=(0.001, 0.02)) as monkey:
+            losses = _run_steps(tr, loss, batch, 30)
+        _check(monkey.total_injected() > 0,
+               f"faults injected ({monkey.injected})")
+        _check(np.isfinite(losses).all(), "all losses finite")
+        _check(np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.8,
+               f"converged {np.mean(losses[:5]):.3f} -> "
+               f"{np.mean(losses[-5:]):.3f}")
+        retries = obs_metrics.default_registry().get(
+            "pserver_client_retries_total")
+        _check(retries is not None and retries.total() >= 1,
+               f"retries recorded "
+               f"({retries.total() if retries else 0:.0f})")
+        tr.close()
+    finally:
+        fluid.set_flag("observe", False)
+        for s in servers:
+            s.stop()
+
+
+def drill_pserver_kill(seed, workdir):
+    fluid.set_flag("observe", True)
+    obs_metrics.default_registry().reset()
+    # no-fault reference band
+    servers, tr, loss, batch = _fresh_world(seed)
+    try:
+        ref = _run_steps(tr, loss, batch, 30)
+        tr.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+    servers, tr, loss, batch = _fresh_world(seed)
+    try:
+        losses = _run_steps(tr, loss, batch, 12)
+        ckpt = os.path.join(workdir, "shards")
+        tr.save(ckpt)
+        for s in servers:
+            ark.verify_sidecar(s._shard_path(ckpt))
+        print(f"  shards checkpointed to {ckpt} (manifests verified)")
+
+        victim = chaos.kill_server(servers[1])
+        print(f"  killed pserver {victim} mid-epoch")
+        time.sleep(0.1)
+        servers[1] = chaos.restart_server(victim, recover_dir=ckpt)
+        print(f"  restarted {victim}, shard recovered")
+
+        losses += _run_steps(tr, loss, batch, 18)
+        _check(np.isfinite(losses).all(), "all losses finite")
+        band = np.mean(ref[-6:]) * 1.25 + 0.05
+        _check(np.mean(losses[-6:]) < band,
+               f"final loss {np.mean(losses[-6:]):.4f} within no-fault "
+               f"band (<{band:.4f})")
+        retries = obs_metrics.default_registry().get(
+            "pserver_client_retries_total")
+        print(f"  client retries: "
+              f"{retries.total() if retries else 0:.0f}")
+        tr.close()
+    finally:
+        fluid.set_flag("observe", False)
+        for s in servers:
+            s.stop()
+
+
+def drill_ckpt_crash(seed, workdir):
+    d = os.path.join(workdir, "ck")
+    arrays = {"w": np.arange(12, dtype=np.float32)}
+    ark.save_checkpoint(d, arrays, cursor={"step_id": 1},
+                        rng={"train_runs": 1})
+    good = ark.latest_checkpoint(d)
+
+    # crash inside the save, after files are staged but before commit
+    class Crash(Exception):
+        pass
+
+    def dying_shard_saver(stage):
+        with open(os.path.join(stage, "shard.bin"), "wb") as f:
+            f.write(b"half-written shard")
+        raise Crash("process died mid-save")
+
+    try:
+        ark.save_checkpoint(d, {"w": arrays["w"] * 2},
+                            cursor={"step_id": 2},
+                            shard_saver=dying_shard_saver)
+    except Crash:
+        print("  crash injected mid-save_checkpoint")
+    _check(ark.latest_checkpoint(d) == good,
+           "previous serial is still the newest committed one")
+    ark.verify_checkpoint(good)
+    print("  previous serial verifies (manifest checksums)")
+    got, manifest = ark.load_checkpoint(good)
+    _check(np.array_equal(got["w"], arrays["w"]) and
+           manifest["cursor"]["step_id"] == 1,
+           "previous checkpoint loads intact")
+
+
+def drill_sync_evict(seed, workdir):
+    fluid.set_flag("observe", True)
+    obs_metrics.default_registry().reset()
+    srv = ParameterServer("127.0.0.1:0", trainers=2,
+                          sync_timeout=120.0).start()
+    ep = srv.endpoint
+    c = PSClient([ep])
+    try:
+        c.init_param(ep, "w", np.zeros(3, np.float32), "sgd", 1.0, {})
+        c.heartbeat(ep, trainer_id=1, session="doomed", lease_s=0.5)
+        print("  trainer 1 held a 0.5s lease, then died")
+        time.sleep(0.8)
+        c.push_grads_sync({ep: {"w": np.full(3, 2.0, np.float32)}},
+                          batch_id=0, trainer_id=0, session="alive")
+        t0 = time.monotonic()
+        c.sync_apply([ep])
+        dt = time.monotonic() - t0
+        _check(dt < 10.0, f"barrier released in {dt:.2f}s "
+                          f"(sync_timeout=120s)")
+        _check(np.allclose(c.get_param(ep, "w"), -2.0),
+               "survivor's update applied once, averaged over live world")
+        evicted = obs_metrics.default_registry().get(
+            "pserver_trainers_evicted_total")
+        _check(evicted is not None and evicted.total() == 1,
+               "eviction metered")
+        c.close()
+    finally:
+        fluid.set_flag("observe", False)
+        srv.stop()
+
+
+SCENARIOS = {
+    "flaky_rpc": drill_flaky_rpc,
+    "pserver_kill": drill_pserver_kill,
+    "ckpt_crash": drill_ckpt_crash,
+    "sync_evict": drill_sync_evict,
+}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--scenario", required=True, choices=sorted(SCENARIOS),
+                    help="fault scenario to drill")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--workdir", default=None,
+                    help="scratch dir (default: a fresh tempdir)")
+    args = ap.parse_args()
+    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_drill_")
+    os.makedirs(workdir, exist_ok=True)
+    print(f"chaos drill: {args.scenario} (seed {args.seed})")
+    t0 = time.monotonic()
+    try:
+        SCENARIOS[args.scenario](args.seed, workdir)
+    except DrillFailure as e:
+        print(f"DRILL FAILED: {e}")
+        return 1
+    print(f"DRILL PASSED in {time.monotonic() - t0:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
