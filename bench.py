@@ -320,6 +320,32 @@ def step_overhead_subprocess():
                 "step_overhead_reduction_x": 0.0}
 
 
+def serve_loadgen_subprocess():
+    """fluid-serve numbers: run tools/serve_loadgen.py in a SUBPROCESS
+    on the CPU backend (serving host mechanics — batching, bucketing,
+    swap — are backend-independent python around a prepared step, and
+    this process already owns the TPU backend; same isolation rationale
+    as the feeder demo). Nonzero exit = a steady-state recompile or a
+    failed request; the sentinel keeps that visible in the JSON."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools",
+                "serve_loadgen.py"), "--duration", "6"],
+            capture_output=True, text=True, timeout=600)
+        line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+        rec = json.loads(line)
+        if out.returncode != 0:
+            rec["serve_loadgen_rc"] = out.returncode
+        return rec
+    except Exception as e:
+        print(f"WARNING: serve loadgen failed ({e!r})", file=sys.stderr)
+        return {"serve_p50_us": 0.0, "serve_p99_us": 0.0,
+                "serve_qps": 0.0, "serve_recompiles": -1}
+
+
 def tpu_gated_tests():
     """The TPU-gated flash-dropout + long-context suites must pass on the
     CURRENT build at bench time (round-4 verdict item 10)."""
@@ -665,6 +691,15 @@ def main():
              "step_overhead_us_unprepared", 0.0),
          step_overhead_reduction_x=overhead.get(
              "step_overhead_reduction_x", 0.0))
+    # fluid-serve: p50/p99/qps + the zero-steady-state-recompiles gate
+    # (recompiles: 0 = observatory-verified clean run; -1 = the loadgen
+    # itself failed to produce numbers)
+    _PARTIAL["extra"]["failure_stage"] = "serve_loadgen_subprocess"
+    srv = serve_loadgen_subprocess()
+    note(serve_p50_us=srv.get("serve_p50_us", 0.0),
+         serve_p99_us=srv.get("serve_p99_us", 0.0),
+         serve_qps=srv.get("serve_qps", 0.0),
+         serve_recompiles=srv.get("serve_recompiles", -1))
     # the headline pair is drift-sensitive through the dev tunnel, and
     # the noise is ONE-SIDED: a stall can only lower a reading below the
     # true device rate, never raise it (the device cannot run faster
@@ -730,6 +765,16 @@ def main():
             "step_overhead_us_unprepared", 0.0),
         "step_overhead_reduction_x": overhead.get(
             "step_overhead_reduction_x", 0.0),
+        # fluid-serve (CPU subprocess loadgen: mixed-shape open loop,
+        # >=2 buckets, 4 client threads, mid-run hot swap)
+        "serve_p50_us": srv.get("serve_p50_us", 0.0),
+        "serve_p99_us": srv.get("serve_p99_us", 0.0),
+        "serve_qps": srv.get("serve_qps", 0.0),
+        "serve_recompiles": srv.get("serve_recompiles", -1),
+        "serve_occupancy": srv.get("serve_occupancy", 0.0),
+        "serve_padding_waste": srv.get("serve_padding_waste", 0.0),
+        "serve_hot_swap_ok": srv.get("serve_hot_swap_ok", False),
+        "serve_failed": srv.get("serve_failed", -1),
         # both readings behind the keep-the-max headline metrics, so the
         # recorded JSON preserves the spread (advisor r5)
         "transformer_base_wmt_tokens_per_sec_first": round(tok_unf_first, 0),

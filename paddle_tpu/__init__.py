@@ -80,6 +80,7 @@ from .transpiler import memory_optimize, release_memory, InferenceTranspiler  # 
 from . import distributed  # noqa: F401
 from . import pserver  # noqa: F401
 from . import ark  # noqa: F401  (fluid-ark fault-tolerant training)
+from . import serve  # noqa: F401  (fluid-serve TPU inference serving)
 from . import master  # noqa: F401
 from . import recordio  # noqa: F401
 from .trainer import (Trainer, Inferencer, CheckpointConfig,  # noqa: F401

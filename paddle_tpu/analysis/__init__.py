@@ -24,7 +24,8 @@ from typing import List, Optional, Sequence
 from ..core import ir
 from .diagnostics import (Diagnostic, ProgramVerificationError,  # noqa: F401
                           Severity, format_diagnostics, has_errors,
-                          lint_program, sort_diagnostics)
+                          lint_dead_fetch_targets, lint_program,
+                          sort_diagnostics)
 from .shape_infer import check_program_shapes, infer_program_shapes  # noqa: F401
 from .verifier import verify_program  # noqa: F401
 

@@ -116,8 +116,10 @@ def lint_program(program: ir.Program,
     diags: List[Diagnostic] = []
     diags += _lint_float64(program)
     diags += _lint_feed_shape_hazards(program)
+    diags += _lint_static_inference_feeds(program)
     if fetch_targets:
         diags += _lint_dead_ops(program, list(fetch_targets))
+        diags += lint_dead_fetch_targets(program, list(fetch_targets))
     return diags
 
 
@@ -221,3 +223,66 @@ def _lint_dead_ops(program: ir.Program,
 
 _SIDE_EFFECT_OPS = frozenset({"feed", "fetch", "listen_and_serv", "print",
                               "py_reader", "read", "send", "recv"})
+
+
+def _lint_static_inference_feeds(program: ir.Program) -> List[Diagnostic]:
+    """Inference programs whose feed vars declare FULLY static shapes
+    (batch dim included) lock the request path to exactly one shape: a
+    shape-bucketing server (serve/) cannot pad a 3-row request onto an
+    8-row rung, and every client must submit the declared batch size
+    exactly. Legal — one warm compile serves all traffic — but it
+    defeats micro-batch coalescing, so it rates an INFO note on the
+    inference slice only (training programs routinely pin the batch)."""
+    if not getattr(program, "_is_inference", False):
+        return []
+    diags = []
+    blk = program.global_block()
+    for v in blk.vars.values():
+        if v.is_data and v.shape and -1 not in v.shape:
+            diags.append(Diagnostic(
+                "static-inference-feed", Severity.INFO,
+                f"feed var {v.name!r} declares the fully static shape "
+                f"{tuple(v.shape)}: every request must match it exactly, "
+                f"so a shape-bucketing server cannot coalesce or pad "
+                f"mixed batch sizes — declare the batch dim as -1 to "
+                f"enable bucketing", block_idx=blk.idx, var=v.name))
+    return diags
+
+
+def lint_dead_fetch_targets(program: ir.Program,
+                            fetch_targets: Sequence[str]
+                            ) -> List[Diagnostic]:
+    """Fetch targets NOTHING in the program produces: no op writes them
+    and they are neither feeds nor persistables, so fetching reads an
+    undefined value. The classic way to get one is `save_inference_model`
+    pruning: a target wired to the training-only graph survives in the
+    vars table while its producing op is stripped by the for_test clone —
+    the saved model then loads fine and serves garbage."""
+    blk = program.global_block()
+    produced = set()
+    for op in blk.ops:
+        for n in op.output_arg_names:
+            if n == registry.EMPTY_VAR:
+                continue
+            produced.add(n)
+            # runtime seqlen propagation materializes @SEQLEN companions
+            # without an explicit producing op
+            produced.add(n + ir.SEQLEN_SUFFIX)
+            produced.add(n + ir.SEQLEN_SUFFIX + ".1")
+    diags = []
+    for t in fetch_targets:
+        if t in produced:
+            continue
+        v = blk._find_var_recursive(t)
+        if v is None or v.is_data or v.persistable:
+            # nonexistent targets are the verifier's ERROR; feeds and
+            # persistables have well-defined values without a producer
+            continue
+        diags.append(Diagnostic(
+            "dead-fetch-target", Severity.WARNING,
+            f"fetch target {t!r} is produced by no op in this program "
+            f"and is neither a feed nor persistable — fetching it reads "
+            f"an undefined value (was its producer pruned away by "
+            f"save_inference_model's for_test clone?)",
+            block_idx=blk.idx, var=t))
+    return diags

@@ -521,6 +521,11 @@ class PreparedProgram:
         self._copts = resolve_compiler_options(self._device.platform, program)
         ls = [op for op in self._block.ops if op.type == "listen_and_serv"]
         self._serve_attrs = ls[0].attrs if ls else None
+        # telemetry attribution: the serving layer (serve/) re-tags its
+        # handles "serving" so step stats and compile events separate
+        # request traffic from training, and shape misses attribute as
+        # `padding_bucket` (mis-sized bucket ladder) not `feed_shape`
+        self.telemetry_source = "executor"
         self._entries: Dict[tuple, _CompiledProgram] = {}
         self._entry: Optional[_CompiledProgram] = None
         self._entry_keys = frozenset()
@@ -603,7 +608,7 @@ class PreparedProgram:
             # feed_shape observatory: a new shape/dtype signature on a
             # bound entry means jax.jit retraces + XLA recompiles
             _steplog.track_shapes(entry, program._uid, feed_arrays,
-                                  source="executor")
+                                  source=self.telemetry_source)
             t1 = time.perf_counter()
         counter = self._exe._count_run(program._uid)
         mut, const = self._state.get(entry, self.scope)
@@ -644,7 +649,7 @@ class PreparedProgram:
             if bound:
                 phases["bind"] = t1 - t_fc
             _steplog.get_steplog().record(_steplog.StepStats(
-                program._uid, "executor", time.time(), phases))
+                program._uid, self.telemetry_source, time.time(), phases))
         return fetches
 
     def _build_feed_plan(self, feed):
@@ -686,7 +691,7 @@ class PreparedProgram:
                     program._uid, program._version, sig,
                     tuple(self.fetch_names),
                     tuple(sorted(copts.items())) if copts else None,
-                    source="executor", scope_uid=self.scope._uid)
+                    source=self.telemetry_source, scope_uid=self.scope._uid)
                 stream = exe._stream_for(program._uid)
                 with jax.default_device(self._device):
                     entry = _CompiledProgram(

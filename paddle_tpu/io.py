@@ -12,6 +12,7 @@ scope arrays to one .npz per save (or one file per var with
 from __future__ import annotations
 
 import json
+import logging
 import os
 import shutil
 import time
@@ -20,12 +21,25 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from .ark.checkpoint import atomic_file
+from .ark.checkpoint import atomic_file, file_sha256
 from .core import ir
 from .core.executor import Executor, Scope, global_scope
 
+logger = logging.getLogger(__name__)
+
 MODEL_FILENAME = "__model__"
 PARAMS_SUFFIX = ".npy"
+# same name + schema as ark's checkpoint manifest, so
+# `ark.checkpoint.verify_checkpoint(model_dir)` works on a model dir too
+MODEL_MANIFEST = "MANIFEST.json"
+
+
+class ModelIntegrityError(RuntimeError):
+    """A saved inference-model dir fails sha256 verification against its
+    MANIFEST.json — bit rot or a torn copy. The message names the first
+    corrupt/missing file so operators can see WHAT rotted, and loaders
+    (serve.ModelRegistry) can refuse the dir before deserializing any of
+    it."""
 
 
 def _is_persistable(var: ir.Variable) -> bool:
@@ -157,6 +171,12 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                 continue
             if age > 3600:
                 shutil.rmtree(p, ignore_errors=True)
+    # advisory serving lint: a fetch target nothing in the pruned slice
+    # produces (and that isn't fed or persistable) fetches an undefined
+    # value — almost always a target wired to the training-only graph
+    from .analysis.diagnostics import lint_dead_fetch_targets
+    for d in lint_dead_fetch_targets(pruned, target_names):
+        logger.warning("save_inference_model: %s", d.format())
     stage = os.path.join(parent, f".stage_{base}_{uuid.uuid4().hex}")
     os.makedirs(stage)
     try:
@@ -164,6 +184,21 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                   "w") as f:
             json.dump(meta, f)
         save_persistables(executor, stage, pruned, params_filename, scope)
+        # integrity manifest, written LAST inside the stage: a sha256 per
+        # payload file, so load_inference_model (and ark's
+        # verify_checkpoint) can refuse a bit-rotted dir instead of
+        # half-loading it. The dir swap below commits payloads + manifest
+        # as one unit.
+        files = {}
+        for name in sorted(os.listdir(stage)):
+            files[name] = {"sha256": file_sha256(os.path.join(stage, name)),
+                           "bytes": os.path.getsize(
+                               os.path.join(stage, name))}
+        with atomic_file(os.path.join(stage, MODEL_MANIFEST), "w") as f:
+            json.dump({"kind": "inference_model", "saved_at": time.time(),
+                       "feed_names": list(feeded_var_names),
+                       "fetch_names": target_names, "files": files}, f,
+                      indent=1)
         if os.path.isdir(dirname):
             # swap: retire the old dir by rename (fast), bring the stage
             # in, then delete the retired copy. If the swap-in fails the
@@ -185,9 +220,42 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     return target_names
 
 
+def verify_inference_model(dirname) -> Optional[dict]:
+    """Check every file the model dir's MANIFEST.json names against its
+    recorded sha256 (delegating to ark's verify_checkpoint — the two
+    manifests share a schema by design). Returns the manifest dict, or
+    None when the dir predates the manifest protocol (legacy dirs pass
+    unverified — they have nothing to verify against). Raises
+    ModelIntegrityError naming the first missing/corrupt file."""
+    from .ark.checkpoint import CheckpointError, verify_checkpoint
+
+    if not os.path.isfile(os.path.join(dirname, MODEL_MANIFEST)):
+        logger.debug("model dir %s has no %s — legacy save, skipping "
+                     "integrity verification", dirname, MODEL_MANIFEST)
+        return None
+    try:
+        return verify_checkpoint(dirname)
+    except CheckpointError as e:
+        raise ModelIntegrityError(
+            f"inference model dir fails integrity verification: {e}") from e
+    except (OSError, json.JSONDecodeError) as e:
+        raise ModelIntegrityError(
+            f"model dir {dirname}: {MODEL_MANIFEST} is unreadable "
+            f"({e}) — torn or corrupted save") from e
+
+
 def load_inference_model(dirname, executor, model_filename=None,
-                         params_filename=None, scope=None):
-    """reference io.py:654 — returns (program, feed_names, fetch_vars)."""
+                         params_filename=None, scope=None, verify=True):
+    """reference io.py:654 — returns (program, feed_names, fetch_vars).
+
+    `verify=True` (default) checks the whole dir against the sha256
+    MANIFEST.json the atomic `save_inference_model` wrote BEFORE
+    deserializing anything: a bit-rotted or torn dir raises
+    ModelIntegrityError naming the corrupt file instead of half-loading
+    (program json parsed, some params garbage). Legacy dirs without a
+    manifest load unverified."""
+    if verify:
+        verify_inference_model(dirname)
     with open(os.path.join(dirname, model_filename or MODEL_FILENAME)) as f:
         meta = json.load(f)
     program = ir.Program.from_dict(meta["program"])

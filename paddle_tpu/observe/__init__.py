@@ -26,7 +26,7 @@ from .. import flags as _flags
 from . import metrics, steplog, tracer  # noqa: F401
 from .metrics import counter, default_registry, gauge, histogram  # noqa: F401
 from .steplog import (StepStats, get_steplog, observatory,  # noqa: F401
-                      track_shapes)
+                      preseed_shapes, track_shapes)
 from .tracer import get_tracer  # noqa: F401
 
 

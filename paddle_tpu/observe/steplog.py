@@ -27,6 +27,15 @@ Two runtime questions dominate TPU cost and were previously invisible:
                           random_seed)
    - ``uncached``         use_program_cache=False (tests probing
                           recompilation; never attributed further)
+   - ``warmup``           an ahead-of-time compile the serving layer
+                          (serve/) deliberately provoked while warming a
+                          bucket ladder — expected, like ``first_call``
+   - ``padding_bucket``   a shape miss on a ``serving``-source handle:
+                          the request's padded shape was NOT in the
+                          warmed bucket ladder. Same mechanism as
+                          ``feed_shape`` but attributed separately so
+                          `--assert-no-recompiles` distinguishes a
+                          mis-sized ladder from a genuine cache bug
 
    Compile events are recorded regardless of the `observe` flag — a
    compile costs seconds, the record costs microseconds, and the
@@ -161,8 +170,10 @@ class RecompileEvent:
 
 # causes that are expected on a healthy steady-state run and therefore
 # ignored by --assert-no-recompiles (the first compile of each program
-# has to happen; everything else is a recompile someone should explain)
-EXPECTED_CAUSES = ("first_call",)
+# has to happen, and a serving warmup compiles its bucket ladder ahead
+# of traffic on purpose; everything else is a recompile someone should
+# explain)
+EXPECTED_CAUSES = ("first_call", "warmup")
 
 
 class RecompilationObservatory:
@@ -227,16 +238,21 @@ class RecompilationObservatory:
         self._emit_metric(cause, source)
         return cause
 
-    def note_shape_miss(self, program_uid: int, shape_sig, source: str):
+    def note_shape_miss(self, program_uid: int, shape_sig, source: str,
+                        cause: str = "feed_shape"):
         """A bound entry saw a NEW feed shape/dtype signature: jax.jit
         will retrace and XLA will recompile. This is the live counterpart
-        of the lint's feed-shape recompile hazard."""
+        of the lint's feed-shape recompile hazard. On a ``serving``-source
+        handle the caller attributes it ``padding_bucket`` instead — the
+        bucket planner should have padded the request onto a warmed rung,
+        so a miss means the ladder is mis-sized, not that the jit cache
+        misbehaved."""
         with self._lock:
             self._events.append(RecompileEvent(
-                time.time(), program_uid, "feed_shape", source,
+                time.time(), program_uid, cause, source,
                 {"shapes": {n: list(shp)
                             for n, shp, _ in shape_sig}}))
-        self._emit_metric("feed_shape", source)
+        self._emit_metric(cause, source)
 
     def record(self, program_uid: int, cause: str, source: str,
                detail=None):
@@ -302,12 +318,31 @@ def track_shapes(entry, program_uid: int, feed_arrays: Dict,
                  source: str = "executor"):
     """Flag-gated per-step shape tracking: detect jax-level retraces of a
     bound entry. The first signature an entry ever runs is covered by its
-    build event; every NEW signature after that is a `feed_shape` miss."""
+    build event; every NEW signature after that is a `feed_shape` miss —
+    or, on a serving handle (where the bucket planner guarantees every
+    steady-state shape was warmed ahead of time), a `padding_bucket`
+    miss."""
     sig = shape_sig(feed_arrays)
     seen = getattr(entry, "_shape_sigs", None)
     if seen is None:
         seen = entry._shape_sigs = set()
     if sig not in seen:
         if seen:
-            observatory().note_shape_miss(program_uid, sig, source)
+            cause = "padding_bucket" if source == "serving" else "feed_shape"
+            observatory().note_shape_miss(program_uid, sig, source, cause)
         seen.add(sig)
+
+
+def preseed_shapes(entry, feed_arrays: Dict):
+    """Register a feed signature as already-seen on a bound entry WITHOUT
+    recording a shape-miss event. The serving warmup uses this: it runs
+    each bucket shape once ahead of traffic (recording those compiles as
+    the expected `warmup` cause via the observatory), and pre-seeding
+    keeps the tracker from re-flagging the warmed shapes as misses —
+    including when warmup ran with the `observe` flag off and the flag is
+    flipped on later."""
+    sig = shape_sig(feed_arrays)
+    seen = getattr(entry, "_shape_sigs", None)
+    if seen is None:
+        seen = entry._shape_sigs = set()
+    seen.add(sig)

@@ -1,0 +1,249 @@
+"""Shape-bucketing planner: pad requests onto a warm compile ladder.
+
+The executor compiles one XLA program per concrete feed-shape signature
+(core/executor.py), so an unconstrained request stream — every client
+picking its own batch size and sequence length — would recompile per
+novel shape, turning a ~100 µs request into a multi-second one. The
+planner quantizes every request onto a small LADDER of shapes that the
+registry compiles ahead of time at model load:
+
+- the ROWS ladder buckets the batch dim (axis 0, the coalescing axis):
+  a batch of 3 coalesced requests pads with zero rows up to the smallest
+  rung >= 3;
+- per-feed DIM ladders bucket any other dynamic (-1) axis the model
+  declares (sequence lengths, variable spatial dims): each request's
+  extent pads up to its rung, shared across the batch it joins.
+
+Steady-state traffic therefore produces ONLY already-compiled shapes;
+the recompilation observatory (observe/steplog.py) attributes any miss
+on a serving handle as `padding_bucket` — a mis-sized ladder, distinct
+from a genuine cache bug.
+
+Padding is zeros. For the row-wise programs serving targets (each output
+row a function of the same input row — fc/conv/softmax pipelines in
+`is_test` mode), padded rows cannot perturb real rows, so sliced outputs
+are bit-identical to an unpadded run (pinned by tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import ir
+from .errors import BadRequestError
+
+# rungs double: compile count stays logarithmic in the max batch while
+# padding waste is bounded by <2x rows (and far less at occupancy)
+DEFAULT_ROWS_LADDER = (1, 2, 4, 8, 16)
+
+# warm-compile combination guard: rows rungs x per-dim rungs multiply
+MAX_WARM_BUCKETS = 64
+
+
+class BucketLadder:
+    """The shape quantization config of one served model.
+
+    `rows`: ascending batch-dim rungs; the largest is also the
+    micro-batcher's max coalesced batch. `dims`: {feed_name: {axis:
+    rungs}} ladders for non-batch dynamic axes (axis counted on the full
+    array, so the first sequence axis of a [batch, time, d] feed is 1).
+    """
+
+    def __init__(self, rows: Sequence[int] = DEFAULT_ROWS_LADDER,
+                 dims: Optional[Dict[str, Dict[int, Sequence[int]]]] = None):
+        if not rows or any(r <= 0 for r in rows):
+            raise ValueError(f"rows ladder must be positive ints, got {rows!r}")
+        self.rows = tuple(sorted(set(int(r) for r in rows)))
+        self.dims = {name: {int(ax): tuple(sorted(set(int(r) for r in rungs)))
+                            for ax, rungs in axes.items()}
+                     for name, axes in (dims or {}).items()}
+
+    @property
+    def max_rows(self) -> int:
+        return self.rows[-1]
+
+    def rows_rung(self, n: int) -> int:
+        """Smallest rung >= n; raises BadRequestError past the ladder."""
+        for r in self.rows:
+            if r >= n:
+                return r
+        raise BadRequestError(
+            f"request has {n} rows but the ladder tops out at "
+            f"{self.max_rows} — split the request or extend the ladder")
+
+    def dim_rung(self, name: str, axis: int, extent: int) -> int:
+        rungs = self.dims.get(name, {}).get(axis)
+        if not rungs:
+            # no ladder declared for this dynamic axis: serve the extent
+            # as-is (each distinct extent is its own compile — the lint
+            # and the padding_bucket cause make that visible)
+            return extent
+        for r in rungs:
+            if r >= extent:
+                return r
+        raise BadRequestError(
+            f"feed {name!r} axis {axis} extent {extent} exceeds its "
+            f"ladder {rungs} — extend the ladder or reject upstream")
+
+
+def feed_spec(program: ir.Program, feed_names: Sequence[str]
+              ) -> Dict[str, Tuple[Tuple[int, ...], str]]:
+    """{feed name: (declared shape, dtype)} for a loaded inference
+    program. LoD feeds are rejected: their (data, lengths) @SEQLEN
+    expansion is a training-path contract the batcher doesn't model."""
+    blk = program.global_block()
+    spec = {}
+    for name in feed_names:
+        v = blk.vars.get(name)
+        if v is None:
+            raise BadRequestError(
+                f"model declares feed {name!r} but the program has no "
+                f"such variable")
+        if v.lod_level > 0:
+            raise BadRequestError(
+                f"feed {name!r} is a LoD (variable-length sequence) "
+                f"input — not servable through the micro-batcher; pad "
+                f"upstream and re-save with lod_level=0")
+        spec[name] = (tuple(v.shape), str(v.dtype or "float32"))
+    return spec
+
+
+class PlannedRequest:
+    """One request after shape planning: per-feed arrays padded on every
+    non-batch dynamic axis, plus the group signature that decides which
+    queue (and therefore which coalesced batch) it can join."""
+
+    __slots__ = ("feeds", "rows", "group_key")
+
+    def __init__(self, feeds: Dict[str, np.ndarray], rows: int,
+                 group_key: Tuple):
+        self.feeds = feeds
+        self.rows = rows
+        self.group_key = group_key
+
+
+def plan_request(spec: Dict[str, Tuple[Tuple[int, ...], str]],
+                 ladder: BucketLadder,
+                 feed: Dict[str, np.ndarray]) -> PlannedRequest:
+    """Validate + pad one request's non-batch axes onto the ladder."""
+    if set(feed) != set(spec):
+        raise BadRequestError(
+            f"feed names {sorted(feed)} != model feeds {sorted(spec)}")
+    rows = None
+    planned: Dict[str, np.ndarray] = {}
+    key: List = []
+    for name in sorted(spec):
+        shape, dtype = spec[name]
+        arr = np.asarray(feed[name])
+        if arr.ndim != len(shape):
+            raise BadRequestError(
+                f"feed {name!r} has rank {arr.ndim}, model declares "
+                f"rank {len(shape)} ({shape})")
+        if rows is None:
+            rows = int(arr.shape[0])
+            if rows <= 0:
+                raise BadRequestError(f"feed {name!r} has zero rows")
+        elif arr.shape[0] != rows:
+            raise BadRequestError(
+                f"feed {name!r} has {arr.shape[0]} rows; other feeds "
+                f"have {rows} — batch dims must agree")
+        pad = [(0, 0)] * arr.ndim
+        padded_tail = []
+        for ax in range(1, arr.ndim):
+            declared = shape[ax] if ax < len(shape) else -1
+            extent = int(arr.shape[ax])
+            if declared == -1:
+                target = ladder.dim_rung(name, ax, extent)
+                pad[ax] = (0, target - extent)
+                padded_tail.append(target)
+            else:
+                if extent != declared:
+                    raise BadRequestError(
+                        f"feed {name!r} axis {ax} extent {extent} != "
+                        f"declared static {declared}")
+                padded_tail.append(extent)
+        if any(p != (0, 0) for p in pad):
+            arr = np.pad(arr, pad)
+        if str(arr.dtype) != dtype:
+            # mirror DataFeeder's implicit numeric cast so a float64
+            # client payload doesn't silently retrace as a new signature
+            if arr.dtype.kind in "fiub":
+                arr = arr.astype(dtype)
+            else:
+                raise BadRequestError(
+                    f"feed {name!r} dtype {arr.dtype} not castable to "
+                    f"declared {dtype}")
+        planned[name] = arr
+        key.append((name, tuple(padded_tail), dtype))
+    # rows above the top rung can never run; reject at the door so the
+    # queue doesn't accept work the executor must bounce later
+    ladder.rows_rung(rows)
+    return PlannedRequest(planned, rows, tuple(key))
+
+
+def pad_rows(arrays: Dict[str, np.ndarray], rows: int,
+             target: int) -> Dict[str, np.ndarray]:
+    """Zero-pad every array's axis 0 from `rows` to `target`."""
+    if target == rows:
+        return arrays
+    out = {}
+    for name, arr in arrays.items():
+        pad = [(0, 0)] * arr.ndim
+        pad[0] = (0, target - rows)
+        out[name] = np.pad(arr, pad)
+    return out
+
+
+def concat_requests(reqs: Sequence[PlannedRequest]
+                    ) -> Tuple[Dict[str, np.ndarray], int]:
+    """Coalesce same-group requests along axis 0. Returns (feeds, rows)."""
+    if len(reqs) == 1:
+        return dict(reqs[0].feeds), reqs[0].rows
+    names = reqs[0].feeds.keys()
+    feeds = {n: np.concatenate([r.feeds[n] for r in reqs], axis=0)
+             for n in names}
+    return feeds, sum(r.rows for r in reqs)
+
+
+def warm_feed_shapes(spec: Dict[str, Tuple[Tuple[int, ...], str]],
+                     ladder: BucketLadder
+                     ) -> List[Dict[str, np.ndarray]]:
+    """Zero feed dicts covering every (rows rung x dim-rung combo) the
+    planner can emit — the ahead-of-time warm set. Combination count is
+    capped at MAX_WARM_BUCKETS (a ladder that big is a config smell; the
+    registry raises rather than compiling for an hour)."""
+    # per-feed resolved tail-shape choices
+    per_feed: Dict[str, List[Tuple[int, ...]]] = {}
+    for name in sorted(spec):
+        shape, _ = spec[name]
+        choices: List[List[int]] = [[]]
+        for ax in range(1, len(shape)):
+            if shape[ax] == -1:
+                rungs = ladder.dims.get(name, {}).get(ax)
+                if not rungs:
+                    raise BadRequestError(
+                        f"feed {name!r} axis {ax} is dynamic (-1) but the "
+                        f"ladder declares no rungs for it — warmup cannot "
+                        f"enumerate its shapes (pass dims={{{name!r}: "
+                        f"{{{ax}: (...)}}}})")
+                choices = [c + [r] for c in choices for r in rungs]
+            else:
+                choices = [c + [int(shape[ax])] for c in choices]
+        per_feed[name] = [tuple(c) for c in choices]
+    # cartesian product across feeds' tail choices x rows rungs
+    combos: List[Dict[str, Tuple[int, ...]]] = [{}]
+    for name, tails in per_feed.items():
+        combos = [dict(c, **{name: t}) for c in combos for t in tails]
+        if len(combos) * len(ladder.rows) > MAX_WARM_BUCKETS:
+            raise BadRequestError(
+                f"bucket ladder enumerates more than {MAX_WARM_BUCKETS} "
+                f"warm compiles — shrink the rows/dims ladders")
+    out = []
+    for rows in ladder.rows:
+        for combo in combos:
+            out.append({name: np.zeros((rows,) + combo[name],
+                                       dtype=spec[name][1])
+                        for name in spec})
+    return out

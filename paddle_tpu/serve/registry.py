@@ -1,0 +1,292 @@
+"""Hot-swappable model registry: dirs -> warmed PreparedProgram handles.
+
+A served model is a `save_inference_model` dir. The registry turns one
+into a `ModelVersion` — its own Scope holding the params, a
+`PreparedProgram` handle tagged with the `serving` telemetry source, and
+every ladder bucket compiled ahead of traffic — and publishes it behind
+an atomic pointer.
+
+Hot swap protocol (rides PR 4's atomic-dir commit: `save_inference_model`
+stages the whole dir and swaps it in with renames, so a watcher can
+never observe a half-written model):
+
+1. a new version is detected (dir inode/mtime fingerprint changed, or an
+   explicit `reload`);
+2. the new dir is sha256-verified against its MANIFEST.json and loaded
+   into a FRESH scope (`io.load_inference_model(verify=True)`);
+3. every bucket of the ladder is warm-compiled — the new version is
+   ready to serve its first request at full speed;
+4. the published pointer flips under the registry lock — requests that
+   acquired the old version finish on it, new acquisitions get the new
+   one; a request never sees a half-loaded model;
+5. the old version retires once its in-flight refcount drains to zero
+   (`ModelVersion.wait_retired` lets tests and drain logic observe it).
+
+Failures in 2-3 leave the old version serving untouched — a corrupt new
+dir costs an error log, not an outage.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .. import io as _io
+from ..core.executor import CPUPlace, Executor, Place, Scope
+from ..observe import metrics as _metrics
+from ..observe import steplog as _steplog
+from .bucketing import BucketLadder, feed_spec, warm_feed_shapes
+from .errors import ModelNotFoundError, ModelUnavailableError
+
+logger = logging.getLogger(__name__)
+
+
+def _fingerprint(dirname: str):
+    """Identity of the CURRENT committed model dir. save_inference_model
+    replaces the whole dir by rename, so a new save = new inode (and new
+    mtime); stat of the dir itself is race-free against the swap."""
+    st = os.stat(dirname)
+    return (st.st_ino, st.st_mtime_ns)
+
+
+class ModelVersion:
+    """One loaded+warmed immutable version of a served model."""
+
+    def __init__(self, name: str, dirname: str, fingerprint,
+                 program, feed_names: List[str], fetch_names: List[str],
+                 scope: Scope, prepared, ladder: BucketLadder, spec):
+        self.name = name
+        self.dirname = dirname
+        self.fingerprint = fingerprint
+        self.program = program
+        self.feed_names = feed_names
+        self.fetch_names = fetch_names
+        self.scope = scope
+        self.prepared = prepared
+        self.ladder = ladder
+        self.spec = spec
+        self.loaded_at = time.time()
+        self._refs = 0
+        self._retired = False
+        self._fully_retired = threading.Event()
+
+    @property
+    def version_id(self) -> str:
+        return f"{self.fingerprint[0]}:{self.fingerprint[1]}"
+
+    def retired(self) -> bool:
+        return self._fully_retired.is_set()
+
+    def wait_retired(self, timeout: Optional[float] = None) -> bool:
+        """Block until this version is both unpublished and drained of
+        in-flight requests."""
+        return self._fully_retired.wait(timeout)
+
+
+class _Slot:
+    """Published pointer + load config for one model name."""
+
+    def __init__(self, dirname: str, ladder: BucketLadder):
+        self.dirname = dirname
+        self.ladder = ladder
+        self.current: Optional[ModelVersion] = None
+
+
+class ModelRegistry:
+    def __init__(self, place: Optional[Place] = None,
+                 executor: Optional[Executor] = None):
+        self._exe = executor or Executor(place or CPUPlace())
+        self._lock = threading.Lock()
+        self._slots: Dict[str, _Slot] = {}
+        self._watcher: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- loading / swapping ----------------------------------------------
+
+    def load(self, name: str, dirname: str,
+             ladder: Optional[BucketLadder] = None,
+             warm: bool = True) -> ModelVersion:
+        """Load (first call) or hot-swap (subsequent calls) `name` from
+        `dirname`. Blocks until the new version is verified, loaded and
+        warmed; only then does the published pointer flip."""
+        dirname = os.path.abspath(dirname)
+        with self._lock:
+            slot = self._slots.get(name)
+            if slot is None:
+                slot = self._slots[name] = _Slot(
+                    dirname, ladder or BucketLadder())
+            else:
+                slot.dirname = dirname
+                if ladder is not None:
+                    slot.ladder = ladder
+        ver = self._load_version(name, dirname, slot.ladder, warm)
+        with self._lock:
+            old, slot.current = slot.current, ver
+            if old is not None:
+                old._retired = True
+                if old._refs == 0:
+                    old._fully_retired.set()
+        if old is not None:
+            _metrics.counter(
+                "serve_hot_swaps_total",
+                "model versions atomically swapped in").inc(model=name)
+            logger.info("serve: hot-swapped model %r -> version %s "
+                        "(old drains %d in-flight)", name, ver.version_id,
+                        old._refs)
+        return ver
+
+    def _load_version(self, name, dirname, ladder, warm) -> ModelVersion:
+        t0 = time.perf_counter()
+        fp = _fingerprint(dirname)
+        scope = Scope()
+        # verify=True: sha256 the whole dir against its MANIFEST before
+        # deserializing — a bit-rotted dir raises ModelIntegrityError
+        # here and the previously published version keeps serving
+        program, feed_names, fetch_vars = _io.load_inference_model(
+            dirname, self._exe, scope=scope, verify=True)
+        spec = feed_spec(program, feed_names)
+        prepared = self._exe.prepare(program, fetch_list=fetch_vars,
+                                     scope=scope)
+        prepared.telemetry_source = "serving"
+        ver = ModelVersion(name, dirname, fp, program, list(feed_names),
+                           [v.name for v in fetch_vars], scope, prepared,
+                           ladder, spec)
+        if warm:
+            self._warm(ver)
+        _metrics.counter("serve_model_loads_total",
+                         "model versions loaded (incl. warmup)").inc(
+                             model=name)
+        _metrics.histogram(
+            "serve_model_load_seconds",
+            "load+verify+warm wall time per version").observe(
+                time.perf_counter() - t0, model=name)
+        return ver
+
+    def _warm(self, ver: ModelVersion):
+        """Compile every ladder bucket ahead of traffic. The first run
+        binds the entry (`first_call` compile); each further bucket shape
+        is recorded as the expected `warmup` cause and pre-seeded into
+        the shape tracker, so steady-state traffic on warmed shapes
+        produces ZERO recompile events — and any later unwarmed shape
+        attributes as `padding_bucket`."""
+        warm_feeds = warm_feed_shapes(ver.spec, ver.ladder)
+        obs = _steplog.observatory()
+        for i, feeds in enumerate(warm_feeds):
+            if i > 0:
+                # the entry exists after the first run; pre-seed BEFORE
+                # running so the tracker never counts warmup as a miss
+                # (works with the observe flag off too), and record the
+                # deliberate compile under its own expected cause
+                _steplog.preseed_shapes(ver.prepared._entry, feeds)
+                obs.record(ver.program._uid, "warmup", "serving",
+                           {"shapes": {n: list(a.shape)
+                                       for n, a in feeds.items()}})
+            ver.prepared.run(feeds)
+        if warm_feeds:
+            # the first bucket's signature too (its run may have happened
+            # with the observe flag off, never reaching the tracker)
+            _steplog.preseed_shapes(ver.prepared._entry, warm_feeds[0])
+
+    def reload(self, name: str, force: bool = False) -> bool:
+        """Re-check `name`'s dir; hot-swap if its fingerprint changed (or
+        unconditionally with `force`). Returns True when a swap
+        happened."""
+        slot = self._slot(name)
+        fp = _fingerprint(slot.dirname)
+        cur = slot.current
+        if not force and cur is not None and fp == cur.fingerprint:
+            return False
+        self.load(name, slot.dirname, ladder=slot.ladder)
+        return True
+
+    # -- request-path access ---------------------------------------------
+
+    def _slot(self, name: str) -> _Slot:
+        with self._lock:
+            slot = self._slots.get(name)
+        if slot is None:
+            raise ModelNotFoundError(
+                f"no model registered as {name!r} "
+                f"(registered: {sorted(self._slots)})")
+        return slot
+
+    def get(self, name: str) -> ModelVersion:
+        """The currently published version (no refcount — use acquire/
+        release on the request path)."""
+        ver = self._slot(name).current
+        if ver is None:
+            raise ModelUnavailableError(
+                f"model {name!r} has no servable version (load failed or "
+                f"in flight)")
+        return ver
+
+    def acquire(self, name: str) -> ModelVersion:
+        """Pin the current version for one batch: the version cannot
+        fully retire until every acquisition is released."""
+        with self._lock:
+            slot = self._slots.get(name)
+            ver = slot.current if slot is not None else None
+            if slot is None:
+                raise ModelNotFoundError(f"no model registered as {name!r}")
+            if ver is None:
+                raise ModelUnavailableError(
+                    f"model {name!r} has no servable version")
+            ver._refs += 1
+        return ver
+
+    def release(self, ver: ModelVersion):
+        with self._lock:
+            ver._refs -= 1
+            if ver._retired and ver._refs == 0:
+                ver._fully_retired.set()
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._slots)
+
+    # -- dir watching ------------------------------------------------------
+
+    def start_watch(self, interval_s: float = 2.0):
+        """Poll every registered model dir; hot-swap on change. Idempotent.
+        Polling (not inotify) keeps it dependency-free and works on the
+        network filesystems model pushes actually land on."""
+        if self._watcher is not None and self._watcher.is_alive():
+            return
+        self._stop.clear()
+
+        def _loop():
+            while not self._stop.wait(interval_s):
+                for name in self.names():
+                    try:
+                        if self.reload(name):
+                            logger.info("serve: watcher swapped %r", name)
+                    except Exception as e:
+                        # incl. FileNotFoundError in a swap's rename
+                        # window and ModelIntegrityError on a bad push —
+                        # the published version keeps serving
+                        logger.warning("serve: watcher reload of %r "
+                                       "failed: %r", name, e)
+
+        self._watcher = threading.Thread(target=_loop, daemon=True,
+                                         name="serve-model-watcher")
+        self._watcher.start()
+
+    def stop_watch(self):
+        self._stop.set()
+        if self._watcher is not None:
+            self._watcher.join(timeout=5)
+            self._watcher = None
+
+    def close(self):
+        self.stop_watch()
+        with self._lock:
+            for slot in self._slots.values():
+                if slot.current is not None:
+                    slot.current._retired = True
+                    if slot.current._refs == 0:
+                        slot.current._fully_retired.set()
+                slot.current = None
+            self._slots.clear()

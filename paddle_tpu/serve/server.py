@@ -1,0 +1,170 @@
+"""InferenceServer: the in-process serving facade.
+
+Ties the registry (hot-swappable warmed models) to one MicroBatcher per
+model and exposes the two request APIs:
+
+    srv = serve.InferenceServer(fluid.CPUPlace())
+    srv.add_model("ranker", "/models/ranker",
+                  ladder=serve.BucketLadder(rows=(1, 2, 4, 8)))
+    out, = srv.infer("ranker", {"x": batch})          # blocking
+    fut  = srv.submit("ranker", {"x": batch})         # Future
+
+`infer` blocks on the request's Future; `submit` returns it so callers
+can pipeline. Both take `deadline_ms`; `start_watch()` begins polling
+every model dir for atomically-pushed new versions. In-process by
+design: the RPC transport in front of this (pserver/rpc.py is the
+in-repo candidate) only moves bytes — batching, bucketing, swap and
+admission semantics all live here and are what the tests pin.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FuturesTimeout
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.executor import Executor, Place
+from ..observe import metrics as _metrics
+from .batcher import MicroBatcher
+from .bucketing import BucketLadder
+from .errors import DeadlineExceededError, ModelNotFoundError
+from .registry import ModelRegistry
+
+
+@dataclass
+class ServeConfig:
+    """Per-server defaults (overridable per model in add_model)."""
+
+    batch_timeout_ms: float = 2.0     # max wait of a lone request
+    max_queue: int = 256              # admission-control bound, requests
+    default_deadline_ms: Optional[float] = None
+    watch_interval_s: float = 2.0
+
+
+class InferenceServer:
+    def __init__(self, place: Optional[Place] = None,
+                 config: Optional[ServeConfig] = None):
+        self.config = config or ServeConfig()
+        self._exe = Executor(place) if place is not None else Executor()
+        self.registry = ModelRegistry(executor=self._exe)
+        self._batchers: Dict[str, MicroBatcher] = {}
+        self._closed = False
+
+    # -- model management ------------------------------------------------
+
+    def add_model(self, name: str, dirname: str,
+                  ladder: Optional[BucketLadder] = None,
+                  batch_timeout_ms: Optional[float] = None,
+                  max_queue: Optional[int] = None, warm: bool = True):
+        """Load, verify, warm and publish a model, then start its
+        executor thread. Calling again with the same name hot-swaps (and
+        applies any explicitly passed batcher settings to the live
+        batcher)."""
+        self.registry.load(name, dirname, ladder=ladder, warm=warm)
+        if name not in self._batchers:
+            self._batchers[name] = MicroBatcher(
+                self.registry, name,
+                batch_timeout_ms=(batch_timeout_ms
+                                  if batch_timeout_ms is not None
+                                  else self.config.batch_timeout_ms),
+                max_queue=(max_queue if max_queue is not None
+                           else self.config.max_queue))
+        else:
+            self._batchers[name].reconfigure(
+                batch_timeout_ms=batch_timeout_ms, max_queue=max_queue)
+        return self.registry.get(name)
+
+    def reload(self, name: str, force: bool = False) -> bool:
+        """Explicit hot-swap check (the watcher calls the same path)."""
+        return self.registry.reload(name, force=force)
+
+    def start_watch(self, interval_s: Optional[float] = None):
+        self.registry.start_watch(interval_s if interval_s is not None
+                                  else self.config.watch_interval_s)
+
+    # -- request path ----------------------------------------------------
+
+    def submit(self, name: str, feed: Dict[str, np.ndarray],
+               deadline_ms: Optional[float] = None) -> Future:
+        batcher = self._batchers.get(name)
+        if batcher is None:
+            raise ModelNotFoundError(
+                f"no model registered as {name!r} "
+                f"(registered: {sorted(self._batchers)})")
+        if deadline_ms is None:
+            deadline_ms = self.config.default_deadline_ms
+        return batcher.submit(feed, deadline_ms=deadline_ms)
+
+    def infer(self, name: str, feed: Dict[str, np.ndarray],
+              deadline_ms: Optional[float] = None) -> List[np.ndarray]:
+        """Synchronous request: returns the fetch list (row-sliced back
+        to this request's rows)."""
+        if deadline_ms is None:
+            deadline_ms = self.config.default_deadline_ms
+        fut = self.submit(name, feed, deadline_ms=deadline_ms)
+        if deadline_ms is None:
+            return fut.result()
+        # the batcher enforces the QUEUED deadline; the slack covers a
+        # batch already on the chip when the deadline strikes
+        # _FuturesTimeout: on Python < 3.11 concurrent.futures raises its
+        # OWN TimeoutError class, not the builtin
+        try:
+            return fut.result(timeout=deadline_ms / 1e3 + 30.0)
+        except (TimeoutError, _FuturesTimeout):
+            raise DeadlineExceededError(
+                f"model {name!r}: no result within deadline "
+                f"{deadline_ms} ms (+30 s execution slack)") from None
+
+    # -- introspection ---------------------------------------------------
+
+    def stats(self) -> dict:
+        """Serving-metric snapshot (the observe registry holds the same
+        numbers in exportable form)."""
+        out: dict = {"models": {}, "ts": time.time()}
+        for name, b in self._batchers.items():
+            ver = None
+            try:
+                ver = self.registry.get(name)
+            except Exception:
+                pass
+            occ = _metrics.histogram("serve_batch_occupancy").summary(
+                model=name)
+            lat = _metrics.histogram("serve_request_latency_us").summary(
+                model=name)
+            waste = _metrics.histogram("serve_padding_waste_ratio").summary(
+                model=name)
+            out["models"][name] = {
+                "version": ver.version_id if ver else None,
+                "loaded_at": ver.loaded_at if ver else None,
+                "queue_depth": b.queue_depth(),
+                "batches": occ["count"] if occ else 0,
+                "avg_occupancy": round(occ["mean"], 3) if occ else 0.0,
+                "avg_latency_us": round(lat["mean"], 1) if lat else 0.0,
+                "avg_padding_waste": round(waste["mean"], 4)
+                    if waste else 0.0,
+                "requests": {
+                    outcome: _metrics.counter("serve_requests_total").value(
+                        model=name, outcome=outcome)
+                    for outcome in ("ok", "error", "deadline", "queue_full")
+                },
+            }
+        return out
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        for b in self._batchers.values():
+            b.close()
+        self._batchers.clear()
+        self.registry.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,527 @@
+"""fluid-serve: registry/bucketing/batcher/server + the io manifest and
+the serving-related lints (ISSUE 5 acceptance coverage).
+
+The model under test is a tiny MLP (compiles in well under a second per
+bucket on the CPU backend); the serving semantics being pinned —
+manifest-gated loads, padding bit-identity, coalescing, admission
+control, deadlines, concurrent hot swap, recompile attribution — are
+size-independent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import analysis, observe, serve
+
+FEAT = 6
+CLASSES = 3
+
+
+def _build():
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 3
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[FEAT], dtype="float32")
+        h = fluid.layers.fc(input=x, size=8, act="relu")
+        pred = fluid.layers.fc(input=h, size=CLASSES, act="softmax")
+    return main, startup, pred
+
+
+def _save_model(dirname, scale=1.0):
+    """Build+init+save; `scale` perturbs params so two saves are
+    observably different models. Returns (program, scope, pred)."""
+    main, startup, pred = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    if scale != 1.0:
+        for v in main.global_block().vars.values():
+            if isinstance(v, fluid.Parameter):
+                scope.set_var(v.name,
+                              np.asarray(scope.find_var(v.name)) * scale)
+    fluid.io.save_inference_model(str(dirname), ["x"], [pred], exe,
+                                  main_program=main, scope=scope)
+    return main, scope, pred
+
+
+def _server(tmp_path, **cfg):
+    mdir = os.path.join(str(tmp_path), "model")
+    _save_model(mdir)
+    srv = serve.InferenceServer(
+        fluid.CPUPlace(),
+        serve.ServeConfig(**{"batch_timeout_ms": 5.0, **cfg}))
+    srv.add_model("m", mdir, ladder=serve.BucketLadder(rows=(1, 2, 4)))
+    return srv, mdir
+
+
+# ---------------------------------------------------------------------------
+# io: integrity manifest (satellite 1)
+# ---------------------------------------------------------------------------
+
+class TestModelManifest:
+    def test_save_writes_manifest_covering_every_file(self, tmp_path):
+        mdir = tmp_path / "model"
+        _save_model(mdir)
+        with open(mdir / fluid.io.MODEL_MANIFEST) as f:
+            manifest = json.load(f)
+        assert manifest["kind"] == "inference_model"
+        payloads = sorted(p for p in os.listdir(mdir)
+                          if p != fluid.io.MODEL_MANIFEST)
+        assert sorted(manifest["files"]) == payloads
+        assert fluid.io.MODEL_FILENAME in manifest["files"]
+        assert manifest["feed_names"] == ["x"]
+
+    def test_bit_rot_raises_named_error_before_deserializing(self, tmp_path):
+        mdir = tmp_path / "model"
+        _save_model(mdir)
+        victim = next(p for p in sorted(os.listdir(mdir))
+                      if p.endswith(".npy"))
+        path = mdir / victim
+        blob = bytearray(path.read_bytes())
+        blob[len(blob) // 2] ^= 0xFF
+        path.write_bytes(bytes(blob))
+        exe = fluid.Executor(fluid.CPUPlace())
+        with pytest.raises(fluid.io.ModelIntegrityError) as ei:
+            fluid.io.load_inference_model(str(mdir), exe,
+                                          scope=fluid.Scope())
+        assert victim in str(ei.value)          # names the corrupt file
+        assert "sha256" in str(ei.value)
+
+    def test_missing_file_raises_torn_error(self, tmp_path):
+        mdir = tmp_path / "model"
+        _save_model(mdir)
+        victim = next(p for p in sorted(os.listdir(mdir))
+                      if p.endswith(".npy"))
+        os.unlink(mdir / victim)
+        with pytest.raises(fluid.io.ModelIntegrityError, match="missing"):
+            fluid.io.load_inference_model(str(mdir),
+                                          fluid.Executor(fluid.CPUPlace()),
+                                          scope=fluid.Scope())
+
+    def test_legacy_dir_without_manifest_still_loads(self, tmp_path):
+        mdir = tmp_path / "model"
+        _save_model(mdir)
+        os.unlink(mdir / fluid.io.MODEL_MANIFEST)
+        scope = fluid.Scope()
+        prog, feeds, fetches = fluid.io.load_inference_model(
+            str(mdir), fluid.Executor(fluid.CPUPlace()), scope=scope)
+        assert feeds == ["x"] and len(fetches) == 1
+
+    def test_registry_refuses_corrupt_dir(self, tmp_path):
+        mdir = tmp_path / "model"
+        _save_model(mdir)
+        victim = next(p for p in sorted(os.listdir(mdir))
+                      if p.endswith(".npy"))
+        (mdir / victim).write_bytes(b"rot")
+        reg = serve.ModelRegistry(place=fluid.CPUPlace())
+        with pytest.raises(fluid.io.ModelIntegrityError):
+            reg.load("m", str(mdir))
+
+
+# ---------------------------------------------------------------------------
+# bucketing
+# ---------------------------------------------------------------------------
+
+class TestBucketing:
+    def test_rows_rung_and_overflow(self):
+        lad = serve.BucketLadder(rows=(1, 2, 4, 8))
+        assert [lad.rows_rung(n) for n in (1, 2, 3, 5, 8)] == [1, 2, 4, 8, 8]
+        with pytest.raises(serve.BadRequestError):
+            lad.rows_rung(9)
+
+    def test_plan_pads_dynamic_axis_and_groups_by_padded_shape(self):
+        spec = {"x": ((-1, -1, 4), "float32")}
+        lad = serve.BucketLadder(rows=(1, 2),
+                                 dims={"x": {1: (8, 16)}})
+        r = serve.plan_request(spec, lad, {"x": np.ones((1, 5, 4), "f4")})
+        assert r.feeds["x"].shape == (1, 8, 4)
+        assert r.rows == 1 and r.group_key == (("x", (8, 4), "float32"),)
+        r2 = serve.plan_request(spec, lad, {"x": np.ones((1, 12, 4), "f4")})
+        assert r2.feeds["x"].shape == (1, 16, 4)
+        assert r2.group_key != r.group_key       # different queue/bucket
+
+    def test_plan_rejects_bad_feeds(self):
+        spec = {"x": ((-1, FEAT), "float32")}
+        lad = serve.BucketLadder(rows=(1, 2))
+        with pytest.raises(serve.BadRequestError):     # wrong names
+            serve.plan_request(spec, lad, {"y": np.ones((1, FEAT), "f4")})
+        with pytest.raises(serve.BadRequestError):     # static mismatch
+            serve.plan_request(spec, lad,
+                               {"x": np.ones((1, FEAT + 1), "f4")})
+        with pytest.raises(serve.BadRequestError):     # over the ladder
+            serve.plan_request(spec, lad, {"x": np.ones((3, FEAT), "f4")})
+
+    def test_warm_feed_shapes_enumerates_ladder(self):
+        spec = {"x": ((-1, FEAT), "float32")}
+        lad = serve.BucketLadder(rows=(1, 4))
+        shapes = [f["x"].shape for f in serve.warm_feed_shapes(spec, lad)]
+        assert shapes == [(1, FEAT), (4, FEAT)]
+
+    def test_warm_requires_dim_rungs_for_dynamic_axes(self):
+        spec = {"x": ((-1, -1, 4), "float32")}
+        with pytest.raises(serve.BadRequestError, match="dynamic"):
+            serve.warm_feed_shapes(spec, serve.BucketLadder(rows=(1,)))
+
+
+# ---------------------------------------------------------------------------
+# padding correctness + batching semantics
+# ---------------------------------------------------------------------------
+
+class TestServing:
+    def test_padded_output_bit_identical_on_valid_region(self, tmp_path):
+        srv, mdir = _server(tmp_path)
+        try:
+            x = np.random.RandomState(0).randn(3, FEAT).astype(np.float32)
+            out, = srv.infer("m", {"x": x})       # 3 rows -> bucket 4
+            ver = srv.registry.get("m")
+            ref, = ver.prepared.run(
+                {"x": np.concatenate([x, np.zeros((1, FEAT), "f4")])})
+            assert out.shape == (3, CLASSES)
+            np.testing.assert_array_equal(out, ref[:3])
+            # and against a direct unpadded run of the same program
+            direct, = ver.prepared.run({"x": x[:1]})  # rows=1 is a rung
+            one, = srv.infer("m", {"x": x[:1]})
+            np.testing.assert_array_equal(one, direct)
+        finally:
+            srv.close()
+
+    def test_concurrent_requests_coalesce(self, tmp_path):
+        srv, _ = _server(tmp_path, batch_timeout_ms=60.0)
+        try:
+            n = 4
+            occ0 = observe.histogram("serve_batch_occupancy").summary(
+                model="m")
+            batches_before = occ0["count"] if occ0 else 0
+            barrier = threading.Barrier(n)
+            outs = [None] * n
+            xs = [np.random.randn(1, FEAT).astype(np.float32)
+                  for _ in range(n)]
+
+            def client(i):
+                barrier.wait()
+                outs[i], = srv.infer("m", {"x": xs[i]})
+
+            ts = [threading.Thread(target=client, args=(i,))
+                  for i in range(n)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=30)
+            for i in range(n):
+                assert outs[i] is not None and outs[i].shape == (1, CLASSES)
+            occ = observe.histogram("serve_batch_occupancy").summary(
+                model="m")
+            # 4 requests released together against a 60 ms window must
+            # coalesce: strictly fewer batches than requests
+            assert occ["count"] - batches_before < n
+            assert occ["max"] >= 2
+        finally:
+            srv.close()
+
+    def test_queue_full_fast_reject_is_retriable(self, tmp_path):
+        srv, _ = _server(tmp_path, batch_timeout_ms=500.0, max_queue=2)
+        try:
+            x = {"x": np.zeros((1, FEAT), "f4")}
+            srv.submit("m", x)
+            srv.submit("m", x)
+            with pytest.raises(serve.QueueFullError) as ei:
+                srv.submit("m", x)
+            assert ei.value.retriable
+        finally:
+            srv.close()
+
+    def test_deadline_exceeded_while_queued(self, tmp_path):
+        srv, _ = _server(tmp_path, batch_timeout_ms=400.0)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(serve.DeadlineExceededError) as ei:
+                srv.infer("m", {"x": np.zeros((1, FEAT), "f4")},
+                          deadline_ms=30)
+            # expired ~at the deadline, NOT at the 400 ms batch window
+            assert time.monotonic() - t0 < 0.35
+            assert ei.value.retriable
+        finally:
+            srv.close()
+
+    def test_deadline_behind_an_undeadlined_head_expires_promptly(
+            self, tmp_path):
+        srv, _ = _server(tmp_path, batch_timeout_ms=400.0)
+        try:
+            zeros = {"x": np.zeros((1, FEAT), "f4")}
+            a = srv.submit("m", zeros)                    # no deadline
+            t0 = time.monotonic()
+            b = srv.submit("m", zeros, deadline_ms=30)    # behind a
+            with pytest.raises(serve.DeadlineExceededError):
+                b.result(timeout=30)
+            # b expired ~at ITS deadline, not at a's 400 ms batch window
+            assert time.monotonic() - t0 < 0.35
+            a.result(timeout=30)                          # a still runs
+        finally:
+            srv.close()
+
+    def test_full_queue_runs_before_older_waiting_head(self, tmp_path):
+        # a dynamic seq axis gives two bucket GROUPS (seq rung 8 vs 16):
+        # a full queue must run immediately even while an older lone
+        # request in the other queue is still inside its batch window
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.layers.data(name="x", shape=[-1, 4], dtype="float32")
+            out = fluid.layers.relu(x)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        mdir = str(tmp_path / "seqmodel")
+        fluid.io.save_inference_model(mdir, ["x"], [out], exe,
+                                      main_program=main, scope=scope)
+        srv = fluid.serve.InferenceServer(
+            fluid.CPUPlace(), serve.ServeConfig(batch_timeout_ms=2000.0))
+        srv.add_model("s", mdir,
+                      ladder=serve.BucketLadder(rows=(1, 2, 4),
+                                                dims={"x": {1: (8, 16)}}))
+        try:
+            lone = srv.submit("s", {"x": np.ones((1, 5, 4), "f4")})
+            t0 = time.monotonic()
+            futs = [srv.submit("s", {"x": np.ones((2, 12, 4), "f4")})
+                    for _ in range(2)]          # 4 rows fill group (16,4)
+            for f in futs:
+                out_, = f.result(timeout=30)
+                assert out_.shape == (2, 16, 4)   # seq padded to its rung
+            assert time.monotonic() - t0 < 1.0    # did NOT wait 2 s
+            assert not lone.done()                # older head still queued
+        finally:
+            srv.close()
+
+    def test_client_cancel_does_not_kill_executor_thread(self, tmp_path):
+        srv, _ = _server(tmp_path, batch_timeout_ms=100.0)
+        try:
+            zeros = {"x": np.zeros((1, FEAT), "f4")}
+            f1 = srv.submit("m", zeros, deadline_ms=50)
+            assert f1.cancel()          # still queued -> cancel succeeds
+            f2 = srv.submit("m", zeros)
+            f2.cancel()
+            time.sleep(0.25)            # expiry sweep + batch window hit
+            # the cancelled futures must not have killed the executor
+            out, = srv.infer("m", zeros, deadline_ms=5000)
+            assert out.shape == (1, CLASSES)
+        finally:
+            srv.close()
+
+    def test_add_model_again_reconfigures_live_batcher(self, tmp_path):
+        srv, mdir = _server(tmp_path, batch_timeout_ms=500.0, max_queue=8)
+        try:
+            srv.add_model("m", mdir, max_queue=1)
+            srv.submit("m", {"x": np.zeros((1, FEAT), "f4")})
+            with pytest.raises(serve.QueueFullError):
+                srv.submit("m", {"x": np.zeros((1, FEAT), "f4")})
+        finally:
+            srv.close()
+
+    def test_unknown_model_and_unregistered_submit(self, tmp_path):
+        srv, _ = _server(tmp_path)
+        try:
+            with pytest.raises(serve.ModelNotFoundError):
+                srv.infer("nope", {"x": np.zeros((1, FEAT), "f4")})
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# hot swap
+# ---------------------------------------------------------------------------
+
+class TestHotSwap:
+    def test_concurrent_hot_swap_zero_errors_and_old_version_retires(
+            self, tmp_path):
+        srv, mdir = _server(tmp_path, batch_timeout_ms=1.0)
+        try:
+            v0 = srv.registry.get("m")
+            swaps_before = observe.counter("serve_hot_swaps_total").value(
+                model="m")
+            x = np.full((1, FEAT), 0.5, "f4")
+            before, = srv.infer("m", {"x": x})
+            errors = []
+            stop = threading.Event()
+
+            def client():
+                while not stop.is_set():
+                    try:
+                        out, = srv.infer("m", {"x": x})
+                        assert out.shape == (1, CLASSES)
+                    except Exception as e:      # noqa: BLE001
+                        errors.append(repr(e))
+
+            ts = [threading.Thread(target=client) for _ in range(4)]
+            for t in ts:
+                t.start()
+            time.sleep(0.3)
+            # atomically publish a new (scaled) version and swap it in
+            _save_model(mdir, scale=2.0)
+            assert srv.reload("m") is True
+            time.sleep(0.3)
+            stop.set()
+            for t in ts:
+                t.join(timeout=30)
+            assert errors == []
+            v1 = srv.registry.get("m")
+            assert v1 is not v0
+            assert v1.version_id != v0.version_id
+            # old version fully retired: unpublished + drained
+            assert v0.wait_retired(10)
+            assert v0._refs == 0
+            # the swap actually changed the served function
+            after, = srv.infer("m", {"x": x})
+            assert not np.array_equal(before, after)
+            assert observe.counter("serve_hot_swaps_total").value(
+                model="m") == swaps_before + 1
+        finally:
+            srv.close()
+
+    def test_watcher_picks_up_atomic_resave(self, tmp_path):
+        srv, mdir = _server(tmp_path)
+        try:
+            v0 = srv.registry.get("m").version_id
+            srv.start_watch(interval_s=0.1)
+            _save_model(mdir, scale=3.0)
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                if srv.registry.get("m").version_id != v0:
+                    break
+                time.sleep(0.05)
+            assert srv.registry.get("m").version_id != v0
+        finally:
+            srv.close()
+
+    def test_reload_without_change_is_a_noop(self, tmp_path):
+        srv, _ = _server(tmp_path)
+        try:
+            assert srv.reload("m") is False
+            assert srv.reload("m", force=True) is True
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# recompilation observatory: serving attribution (satellite 2)
+# ---------------------------------------------------------------------------
+
+class TestServingRecompileAttribution:
+    def test_warmup_expected_steady_state_clean_offladder_attributed(
+            self, tmp_path):
+        flag = fluid.get_flag("observe")
+        fluid.set_flag("observe", True)
+        # the observatory ring is bounded (256) and process-global —
+        # scope every assertion by timestamp, not index
+        t0 = time.time()
+        srv, _ = _server(tmp_path)
+        try:
+            events = [e for e in observe.observatory().events()
+                      if e.ts >= t0]
+            serving = [e for e in events if e.source == "serving"]
+            assert {e.cause for e in serving} == {"first_call", "warmup"}
+            assert len([e for e in serving if e.cause == "warmup"]) == 2
+            t1 = time.time()
+            # steady state on warmed rungs: zero new events
+            for n in (1, 2, 3, 4):
+                srv.infer("m", {"x": np.zeros((n, FEAT), "f4")})
+            assert not [e for e in observe.observatory().unexpected()
+                        if e.ts >= t1]
+            # an off-ladder shape forced PAST the planner (mis-sized
+            # ladder simulation) attributes as padding_bucket, source
+            # serving — distinguishable from a feed_shape cache bug
+            ver = srv.registry.get("m")
+            ver.prepared.run({"x": np.zeros((3, FEAT), "f4")})
+            bad = [e for e in observe.observatory().unexpected()
+                   if e.ts >= t1]
+            assert [e.cause for e in bad] == ["padding_bucket"]
+            assert bad[0].source == "serving"
+        finally:
+            fluid.set_flag("observe", flag)
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# analysis lints (satellite 3)
+# ---------------------------------------------------------------------------
+
+class TestServingLints:
+    def test_fully_static_inference_feed_is_info(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.layers.data(name="xs", shape=[4, FEAT],
+                                  dtype="float32", append_batch_size=False)
+            pred = fluid.layers.fc(input=x, size=2, act="softmax")
+        infer = fluid.io.get_inference_program([pred], main_program=main)
+        infer._is_inference = True
+        diags = [d for d in analysis.lint_program(infer)
+                 if d.code == "static-inference-feed"]
+        assert len(diags) == 1
+        assert diags[0].severity == analysis.Severity.INFO
+        assert diags[0].var == "xs"
+        # the training program does NOT get the note
+        assert not [d for d in analysis.lint_program(main)
+                    if d.code == "static-inference-feed"]
+
+    def test_dynamic_batch_inference_feed_is_clean(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.layers.data(name="x", shape=[FEAT], dtype="float32")
+            pred = fluid.layers.fc(input=x, size=2, act="softmax")
+        infer = fluid.io.get_inference_program([pred], main_program=main)
+        infer._is_inference = True
+        assert not [d for d in analysis.lint_program(infer)
+                    if d.code == "static-inference-feed"]
+
+    def test_dead_fetch_target_warns(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.layers.data(name="x", shape=[FEAT], dtype="float32")
+            fluid.layers.fc(input=x, size=2)
+            orphan = main.global_block().create_var(
+                name="orphan", shape=[-1, 2], dtype="float32")
+        diags = analysis.lint_dead_fetch_targets(main, ["orphan"])
+        assert len(diags) == 1
+        assert diags[0].severity == analysis.Severity.WARNING
+        assert "orphan" in diags[0].message
+        # produced / fed / persistable targets are all fine
+        assert not analysis.lint_dead_fetch_targets(main, ["x"])
+
+    def test_saved_model_fetches_lint_clean(self, tmp_path):
+        mdir = tmp_path / "model"
+        _save_model(mdir)
+        scope = fluid.Scope()
+        prog, feeds, fetches = fluid.io.load_inference_model(
+            str(mdir), fluid.Executor(fluid.CPUPlace()), scope=scope)
+        assert not analysis.lint_dead_fetch_targets(
+            prog, [v.name for v in fetches])
+
+
+# ---------------------------------------------------------------------------
+# CI wrapper: the full load drill (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_serve_loadgen_drill():
+    """Mixed-shape open-loop load + hot swap, observatory-verified zero
+    steady-state recompiles (the ISSUE 5 acceptance drill)."""
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "serve_loadgen.py")
+    out = subprocess.run([sys.executable, tool, "--duration", "10"],
+                         capture_output=True, text=True, timeout=590,
+                         env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    rec = json.loads([l for l in out.stdout.splitlines()
+                      if l.startswith("{")][-1])
+    assert rec["serve_recompiles"] == 0
+    assert rec["serve_failed"] == 0
+    assert rec["serve_hot_swap_ok"] is True
+    assert rec["serve_qps"] > 0 and rec["serve_p99_us"] > 0

@@ -1,0 +1,286 @@
+#!/usr/bin/env python
+"""fluid-serve load generator: closed+open-loop, with a hot-swap drill.
+
+Drives an in-process InferenceServer with mixed-shape traffic and
+reports the serving numbers bench.py records:
+
+    python tools/serve_loadgen.py --duration 10
+        phase 1 (closed loop): N threads issue back-to-back requests —
+        measures the saturated pipeline (coalescing occupancy).
+        phase 2 (open loop): Poisson arrivals at --qps with random
+        request sizes spanning >= 2 buckets — measures p50/p99 latency
+        under realistic load; halfway through, a NEW model version is
+        atomically saved over the model dir and the registry watcher
+        hot-swaps it mid-traffic.
+
+Exit status is the CI gate: nonzero if ANY steady-state recompile was
+recorded by the observatory after warmup (cause `padding_bucket` means
+the bucket ladder is mis-sized; `feed_shape`/anything else means a cache
+bug), if any request failed, or if the hot swap didn't land. The JSON
+line on stdout carries serve_p50_us / serve_p99_us / serve_qps /
+serve_recompiles plus occupancy and padding-waste detail.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def build_and_save(fluid, np, dirname, scale=1.0, seed=7):
+    """Tiny MLP book model -> inference dir. `scale` perturbs the params
+    so a hot-swapped version is observably different."""
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        h = fluid.layers.fc(input=x, size=32, act="relu")
+        pred = fluid.layers.fc(input=h, size=8, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    if scale != 1.0:
+        for v in main.global_block().vars.values():
+            if isinstance(v, fluid.Parameter):
+                arr = np.asarray(scope.find_var(v.name))
+                scope.set_var(v.name, arr * scale)
+    fluid.io.save_inference_model(dirname, ["x"], [pred], exe,
+                                  main_program=main, scope=scope)
+
+
+def percentiles(np, lat_us):
+    if not lat_us:
+        return 0.0, 0.0
+    a = np.asarray(lat_us)
+    return float(np.percentile(a, 50)), float(np.percentile(a, 99))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="fluid-serve load generator")
+    ap.add_argument("--model-dir", help="existing save_inference_model dir "
+                    "with a single feed named 'x' (default: build a tiny "
+                    "MLP in a tempdir)")
+    ap.add_argument("--duration", type=float, default=6.0,
+                    help="seconds per phase (default 6; the open-loop "
+                    "phase hosts the hot-swap drill at its midpoint)")
+    ap.add_argument("--threads", type=int, default=4,
+                    help="client threads per phase (default 4)")
+    ap.add_argument("--qps", type=float, default=300.0,
+                    help="open-loop offered load (default 300)")
+    ap.add_argument("--buckets", default="1,2,4,8",
+                    help="rows ladder (default 1,2,4,8)")
+    ap.add_argument("--batch-timeout-ms", type=float, default=2.0)
+    ap.add_argument("--max-queue", type=int, default=512)
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="per-request deadline (default none)")
+    ap.add_argument("--no-swap", action="store_true",
+                    help="skip the mid-run hot-swap drill")
+    args = ap.parse_args(argv)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import observe, serve
+
+    fluid.set_flag("observe", True)
+
+    mdir = args.model_dir
+    if mdir is None:
+        mdir = os.path.join(tempfile.mkdtemp(prefix="serve_loadgen_"),
+                            "model")
+        build_and_save(fluid, np, mdir)
+
+    rows_ladder = tuple(int(b) for b in args.buckets.split(","))
+    srv = serve.InferenceServer(
+        fluid.CPUPlace(),
+        serve.ServeConfig(batch_timeout_ms=args.batch_timeout_ms,
+                          max_queue=args.max_queue,
+                          watch_interval_s=0.2))
+    srv.add_model("m", mdir, ladder=serve.BucketLadder(rows=rows_ladder))
+    feat = srv.registry.get("m").spec["x"][0][1]   # feature width
+
+    # everything the warmup compiled is on the books now; any unexpected
+    # event past this line is a steady-state recompile
+    baseline_unexpected = len(observe.observatory().unexpected())
+    v0 = srv.registry.get("m").version_id
+
+    rng = random.Random(0)
+    max_req_rows = min(4, rows_ladder[-1])
+    stop = threading.Event()
+    failures = []
+    rejected = [0]
+    fail_lock = threading.Lock()
+
+    def make_feed():
+        n = rng.randint(1, max_req_rows)
+        return {"x": np.random.randn(n, feat).astype(np.float32)}
+
+    def record_failure(e):
+        # retriable = the server exercising backpressure on purpose
+        # (queue full / deadline) — counted, but not a failure; anything
+        # else is a real serving error and fails the run
+        with fail_lock:
+            if getattr(e, "retriable", False):
+                rejected[0] += 1
+            else:
+                failures.append(repr(e))
+
+    # ---- phase 1: closed loop (saturation / coalescing) ----------------
+    closed_lat = []
+    closed_lock = threading.Lock()
+
+    def closed_client():
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                srv.infer("m", make_feed(), deadline_ms=args.deadline_ms)
+            except Exception as e:
+                record_failure(e)
+                continue
+            with closed_lock:
+                closed_lat.append((time.perf_counter() - t0) * 1e6)
+
+    threads = [threading.Thread(target=closed_client, daemon=True)
+               for _ in range(args.threads)]
+    t_closed = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(args.duration)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    closed_wall = time.perf_counter() - t_closed
+    closed_qps = len(closed_lat) / closed_wall
+
+    # ---- phase 2: open loop (Poisson arrivals) + hot-swap drill --------
+    stop.clear()
+    open_lat = []
+    open_lock = threading.Lock()
+    inflight = []
+
+    def open_client(tid):
+        lam = args.qps / args.threads
+        nxt = time.perf_counter()
+        while not stop.is_set():
+            nxt += rng.expovariate(lam)
+            delay = nxt - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            t0 = time.perf_counter()
+            try:
+                fut = srv.submit("m", make_feed(),
+                                 deadline_ms=args.deadline_ms)
+            except Exception as e:
+                record_failure(e)
+                continue
+
+            def done(f, t0=t0):
+                try:
+                    f.result()
+                except Exception as e:
+                    record_failure(e)
+                else:
+                    with open_lock:
+                        open_lat.append((time.perf_counter() - t0) * 1e6)
+
+            fut.add_done_callback(done)
+            inflight.append(fut)
+
+    swapped = {"ok": args.no_swap}
+
+    def swap_drill():
+        time.sleep(args.duration / 2)
+        build_and_save(fluid, np, mdir, scale=1.5, seed=11)
+        deadline = time.time() + max(10.0, args.duration)
+        while time.time() < deadline:
+            if srv.registry.get("m").version_id != v0:
+                swapped["ok"] = True
+                return
+            time.sleep(0.1)
+
+    srv.start_watch()
+    threads = [threading.Thread(target=open_client, args=(i,), daemon=True)
+               for i in range(args.threads)]
+    if not args.no_swap:
+        threads.append(threading.Thread(target=swap_drill, daemon=True))
+    t_open = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(args.duration)
+    stop.set()
+    for t in threads:
+        t.join(timeout=max(15, args.duration))
+    for f in inflight:           # drain: callbacks record their latency
+        try:
+            f.result(timeout=30)
+        except Exception:
+            pass                 # already recorded by the callback
+    open_wall = time.perf_counter() - t_open
+    open_qps = len(open_lat) / open_wall
+
+    stats = srv.stats()["models"]["m"]
+    unexpected = observe.observatory().unexpected()[baseline_unexpected:]
+    recompiles = len(unexpected)
+    srv.close()
+
+    p50, p99 = percentiles(np, open_lat)
+    c50, c99 = percentiles(np, closed_lat)
+    out = {
+        "serve_p50_us": round(p50, 1),
+        "serve_p99_us": round(p99, 1),
+        "serve_qps": round(open_qps, 1),
+        "serve_recompiles": recompiles,
+        "serve_failed": len(failures),
+        "serve_rejected": rejected[0],
+        "serve_hot_swap_ok": bool(swapped["ok"]),
+        "serve_occupancy": stats["avg_occupancy"],
+        "serve_padding_waste": stats["avg_padding_waste"],
+        "serve_closed_p50_us": round(c50, 1),
+        "serve_closed_p99_us": round(c99, 1),
+        "serve_closed_qps": round(closed_qps, 1),
+        "serve_requests_ok": stats["requests"]["ok"],
+        "serve_buckets": list(rows_ladder),
+        "serve_threads": args.threads,
+        "serve_offered_qps": args.qps,
+    }
+    print(json.dumps(out))
+
+    rc = 0
+    if recompiles:
+        causes = sorted({e.cause for e in unexpected})
+        print(f"FAIL: {recompiles} steady-state recompile(s), cause(s) "
+              f"{causes} — padding_bucket = mis-sized ladder, anything "
+              f"else = compile-cache bug", file=sys.stderr)
+        for e in unexpected:
+            print(f"  {e!r} detail={e.detail}", file=sys.stderr)
+        rc = 1
+    if failures:
+        print(f"FAIL: {len(failures)} failed request(s); first: "
+              f"{failures[0]}", file=sys.stderr)
+        rc = 1
+    if not swapped["ok"]:
+        print("FAIL: hot swap never landed (watcher did not pick up the "
+              "new model version)", file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print(f"serve_loadgen OK: p50 {p50:.0f} us, p99 {p99:.0f} us, "
+              f"{open_qps:.0f} qps open-loop ({closed_qps:.0f} closed), "
+              f"occupancy {stats['avg_occupancy']:.2f}, zero steady-state "
+              f"recompiles", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

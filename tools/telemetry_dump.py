@@ -16,6 +16,13 @@ summary, and the recompilation observatory. The interesting CI mode:
         feed-shape recompile-hazard warning (PR 2): the lint predicts
         the hazard, the observatory proves whether it fired.
 
+Serving runs (serve/) tag their events with source="serving": a failure
+whose cause is `padding_bucket` means the bucket ladder is mis-sized
+(the planner emitted a shape warmup never compiled — fix the ladder),
+while `feed_shape`/anything else on a serving source is a genuine
+compile-cache bug. Warmup compiles (`warmup`, `first_call`) are expected
+and never fail the assertion.
+
 Other output modes: --format json (default) | prom (Prometheus text
 exposition) | table (human summary); --trace PATH writes the unified
 chrome://tracing timeline (open in chrome://tracing or perfetto).
