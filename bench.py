@@ -320,6 +320,40 @@ def step_overhead_subprocess():
                 "step_overhead_reduction_x": 0.0}
 
 
+def op_cost_subprocess():
+    """fluid-xray cost model: the per-op cost table of the (scaled-down)
+    book transformer, cross-checked against XLA's own cost_analysis, in
+    a CPU subprocess (static analysis + a 3-step observed run — backend-
+    independent python; same isolation rationale as the other CPU
+    sub-benches). The compact summary lands in the recorded JSON so every
+    bench round carries the cost-attribution story the fluid-planner
+    work will consume."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools",
+                "op_profile.py"), "--model", "transformer", "--json"],
+            capture_output=True, text=True, timeout=600)
+        line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+        rec = json.loads(line)
+        top = rec.get("top") or [{}]
+        return {
+            "op_cost_total_gflops": round(
+                rec.get("total_flops", 0.0) / 1e9, 4),
+            "op_cost_xla_agreement": rec.get("xla_agreement", 0.0),
+            "op_cost_arithmetic_intensity": round(
+                rec.get("arithmetic_intensity", 0.0), 2),
+            "op_cost_top_op": (f"{top[0].get('type')}:{top[0].get('out')}"
+                               f"={top[0].get('flops_share', 0.0):.0%}"
+                               if top[0] else ""),
+        }
+    except Exception as e:
+        print(f"WARNING: op cost profile failed ({e!r})", file=sys.stderr)
+        return {"op_cost_total_gflops": 0.0, "op_cost_xla_agreement": 0.0}
+
+
 def serve_loadgen_subprocess():
     """fluid-serve numbers: run tools/serve_loadgen.py in a SUBPROCESS
     on the CPU backend (serving host mechanics — batching, bucketing,
@@ -410,6 +444,21 @@ def _emit_partial_and_exit(reason=None):
             "global watchdog fired: a segment hung in a native call "
             "(dead tunnel?); metrics below were measured before the "
             "hang, the rest are absent")
+        # flight recorder (fluid-xray): the black box — last N step
+        # records, RPC outcomes, compile events, the failing stage —
+        # lands next to the partial JSON so an abnormal exit leaves a
+        # postmortem artifact, not just a log tail
+        try:
+            from paddle_tpu.observe import flight as _flight
+            _flight.set_stage(str(_PARTIAL["extra"].get("failure_stage")))
+            fp = _flight.dump(
+                os.environ.get("BENCH_FLIGHT_PATH",
+                               "flight_recorder.json"),
+                reason=str(_PARTIAL["extra"]["bench_failure"])[:200])
+            if fp:
+                _PARTIAL["extra"]["flight_recorder"] = fp
+        except Exception:
+            pass
         # the main thread may still be mutating _PARTIAL["extra"]
         # (note(), per-segment bookkeeping) while this thread serializes
         # it — retry the dump (any error: concurrent-mutation
@@ -552,8 +601,10 @@ def main():
 
         # failure_stage: whatever stage is current when the process dies
         # (watchdog/SIGTERM emission) or fails softly is named in the
-        # recorded JSON — the rc=124 diagnosability fix
+        # recorded JSON — the rc=124 diagnosability fix. The flight
+        # recorder mirrors it so a black-box dump names the stage too.
         _PARTIAL["extra"]["failure_stage"] = label
+        _obs.flight.set_stage(label)
         t_seg = time.perf_counter()
         prev = signal.signal(signal.SIGALRM, _alarm)
         signal.alarm(timeout_s)
@@ -600,7 +651,15 @@ def main():
     seg._recompiles_seen = {}
 
     _PARTIAL["extra"]["failure_stage"] = "peak_probe"
+    _obs.flight.set_stage("peak_probe")
     try:
+        # BENCH_SKIP_PEAK=1: jump straight to the segments with the
+        # envelope-midpoint denominator — the probe is chained 4096^3
+        # matmuls sized for a TPU, which on a CPU smoke run (e.g.
+        # rehearsing the SIGTERM/flight-recorder path) would crawl for
+        # hours before the first segment
+        if os.environ.get("BENCH_SKIP_PEAK", "") == "1":
+            raise RuntimeError("BENCH_SKIP_PEAK=1")
         peak = measure_peak_tflops(jax) * 1e12
     except Exception as e:
         # MFU needs SOME denominator; the measured envelope across
@@ -679,12 +738,14 @@ def main():
     note(transformer_seq4096_flash_tokens_per_sec=round(tok_4k_fus, 0),
          transformer_seq4096_unfused_tokens_per_sec=round(tok_4k_unf, 0))
     _PARTIAL["extra"]["failure_stage"] = "feeder_overlap_subprocess"
+    _obs.flight.set_stage("feeder_overlap_subprocess")
     feeder = feeder_overlap_subprocess()
     lstm_tok, lstm_ex = seg(
         "stacked_lstm",
         lambda: bench_stacked_lstm(fluid, models, jax), (0.0, 0.0))
     note(stacked_lstm_examples_per_sec=round(lstm_ex, 1))
     _PARTIAL["extra"]["failure_stage"] = "step_overhead_subprocess"
+    _obs.flight.set_stage("step_overhead_subprocess")
     overhead = step_overhead_subprocess()
     note(step_overhead_us=overhead.get("step_overhead_us", 0.0),
          step_overhead_us_unprepared=overhead.get(
@@ -694,6 +755,10 @@ def main():
     # fluid-serve: p50/p99/qps + the zero-steady-state-recompiles gate
     # (recompiles: 0 = observatory-verified clean run; -1 = the loadgen
     # itself failed to produce numbers)
+    _PARTIAL["extra"]["failure_stage"] = "op_cost_subprocess"
+    _obs.flight.set_stage("op_cost_subprocess")
+    opcost = op_cost_subprocess()
+    note(**opcost)
     _PARTIAL["extra"]["failure_stage"] = "serve_loadgen_subprocess"
     srv = serve_loadgen_subprocess()
     note(serve_p50_us=srv.get("serve_p50_us", 0.0),
@@ -737,6 +802,7 @@ def main():
     _PARTIAL["value"] = round(ips, 2)   # keep the partial record adopted
     note(resnet50_mfu=round(rn_fps / peak, 3))
     _PARTIAL["extra"]["failure_stage"] = "tpu_gated_tests"
+    _obs.flight.set_stage("tpu_gated_tests")
     gated = tpu_gated_tests()
 
     extra = {
@@ -775,6 +841,14 @@ def main():
         "serve_padding_waste": srv.get("serve_padding_waste", 0.0),
         "serve_hot_swap_ok": srv.get("serve_hot_swap_ok", False),
         "serve_failed": srv.get("serve_failed", -1),
+        # fluid-xray per-op cost model (CPU subprocess, scaled-down book
+        # transformer): static total vs XLA cost_analysis agreement is
+        # the health gate — 1.0 means the planner-facing table is honest
+        "op_cost_total_gflops": opcost.get("op_cost_total_gflops", 0.0),
+        "op_cost_xla_agreement": opcost.get("op_cost_xla_agreement", 0.0),
+        "op_cost_arithmetic_intensity": opcost.get(
+            "op_cost_arithmetic_intensity", 0.0),
+        "op_cost_top_op": opcost.get("op_cost_top_op", ""),
         # both readings behind the keep-the-max headline metrics, so the
         # recorded JSON preserves the spread (advisor r5)
         "transformer_base_wmt_tokens_per_sec_first": round(tok_unf_first, 0),

@@ -22,6 +22,7 @@ from __future__ import annotations
 from typing import List, Optional, Sequence
 
 from ..core import ir
+from .cost_model import CostReport, OpCost, estimate_cost  # noqa: F401
 from .diagnostics import (Diagnostic, ProgramVerificationError,  # noqa: F401
                           Severity, format_diagnostics, has_errors,
                           lint_dead_fetch_targets, lint_program,

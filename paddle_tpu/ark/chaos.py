@@ -164,7 +164,10 @@ def kill_server(server) -> str:
     listener closes and every in-flight request is dropped unanswered
     (`_serve_conn` checks the stop event before replying). Returns the
     endpoint so `restart_server` can reuse it."""
+    from ..observe import flight as _flight
+
     ep = server.endpoint
+    _flight.note("chaos_kill", endpoint=ep)
     server.stop()
     return ep
 
@@ -175,10 +178,13 @@ def restart_server(endpoint: str, trainers: int = 1,
     """Bring a fresh ParameterServer up on `endpoint`, recovering its
     shard (values + optimizer slots + sparse tables) from `recover_dir`
     when given — the crash/restart leg of the drill."""
+    from ..observe import flight as _flight
     from ..pserver.server import ParameterServer
 
     srv = ParameterServer(endpoint, trainers=trainers,
                           sync_timeout=sync_timeout).start()
     if recover_dir is not None:
         srv.recover(recover_dir)
+    _flight.note("chaos_restart", endpoint=endpoint,
+                 recovered=recover_dir is not None)
     return srv

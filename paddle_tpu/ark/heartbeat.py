@@ -65,11 +65,15 @@ class HeartbeatThread:
                 ok += 1
             except Exception as e:
                 from .. import flags as _flags
+                from ..observe import flight as _flight
                 from ..observe import metrics as _metrics
                 if _flags.get_flag("observe"):
                     _metrics.counter(
                         "ark_heartbeat_misses_total",
                         "heartbeat renewals that failed").inc(endpoint=ep)
+                    _flight.note("heartbeat_miss", endpoint=ep,
+                                 trainer_id=self.trainer_id,
+                                 error=type(e).__name__)
                 logger.debug("heartbeat to %s failed: %s", ep, e)
         return ok
 

@@ -23,11 +23,12 @@ events are recorded regardless — they are never hot and they are what
 from __future__ import annotations
 
 from .. import flags as _flags
-from . import metrics, steplog, tracer  # noqa: F401
+from . import flight, metrics, steplog, tracer, xray  # noqa: F401
+from .flight import get_flight  # noqa: F401
 from .metrics import counter, default_registry, gauge, histogram  # noqa: F401
 from .steplog import (StepStats, get_steplog, observatory,  # noqa: F401
                       preseed_shapes, track_shapes)
-from .tracer import get_tracer  # noqa: F401
+from .tracer import get_tracer, merge_chrome_traces  # noqa: F401
 
 
 def enabled() -> bool:
@@ -62,3 +63,13 @@ def reset():
     get_tracer().clear()
     get_steplog().clear()
     observatory().clear()
+
+
+def reset_all():
+    """`reset()` plus the fluid-xray stores: flight-recorder ring +
+    stage, and this thread's ambient trace context. The tier-1 autouse
+    fixture calls this so tests stop sharing process-global telemetry
+    state (snapshot-and-delta assertions are no longer required)."""
+    reset()
+    get_flight().clear()
+    xray.reset()

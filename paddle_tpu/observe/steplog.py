@@ -51,6 +51,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+from . import flight as _flight
 from . import metrics as _metrics
 from . import tracer as _tracer
 
@@ -122,6 +123,11 @@ class StepLog:
                 "step", stats.ts, stats.total, cat="step",
                 **{f"{k}_us": round(v * 1e6, 2)
                    for k, v in stats.phases.items()})
+        # flight recorder: callers only invoke record() when observing,
+        # so this rides the same gate as the metric writes
+        _flight.note("step", program_uid=stats.program_uid,
+                     source=stats.source,
+                     total_us=round(stats.total * 1e6, 2))
 
     def recent(self, n: int = 16) -> List[StepStats]:
         with self._lock:
@@ -268,6 +274,9 @@ class RecompilationObservatory:
             "executor_recompiles_total",
             "executor compile events by attributed cause").inc(
                 cause=cause, source=source)
+        # compile events are never hot — they go to the black box
+        # unconditionally, like the metric above
+        _flight.note("compile", cause=cause, source=source)
 
     def events(self) -> List[RecompileEvent]:
         with self._lock:

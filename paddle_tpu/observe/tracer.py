@@ -17,12 +17,30 @@ from __future__ import annotations
 
 import contextlib
 import json
+import os
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
 DEFAULT_CAPACITY = 16384
+
+# chrome-trace process identity (fluid-xray): exports carry the REAL pid
+# plus a human process name as "M"-phase metadata, so per-process trace
+# files from a distributed run merge into one timeline with each process
+# on its own named track (tools/telemetry_dump.py --merge).
+_process_name: Optional[str] = None
+
+
+def set_process_name(name: str):
+    global _process_name
+    _process_name = str(name)
+
+
+def get_process_name() -> str:
+    if _process_name is not None:
+        return _process_name
+    return os.environ.get("PADDLE_TPU_PROC_NAME", f"pid{os.getpid()}")
 
 
 class Span:
@@ -50,6 +68,10 @@ class Tracer:
         self._events: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._local = threading.local()
+        # tid -> thread name, captured at RECORD time: a batcher/conn
+        # thread may be long gone by export time, and an unnamed track
+        # defeats the merged timeline's readability
+        self._tid_names: Dict[int, str] = {}
 
     @property
     def capacity(self) -> int:
@@ -91,10 +113,16 @@ class Tracer:
         region themselves, e.g. the executor's phase timers)."""
         if parent is not None:
             args = dict(args, parent=parent)
-        ev = Span(name, cat, ts, dur,
-                  tid if tid is not None else threading.get_ident(),
-                  depth, args)
+        name_update = None
+        if tid is None:
+            tid = threading.get_ident()
+            # unconditional refresh: idents recycle after a thread exits,
+            # and a stale name on a recycled tid would mislabel the track
+            name_update = threading.current_thread().name
+        ev = Span(name, cat, ts, dur, tid, depth, args)
         with self._lock:
+            if name_update is not None:
+                self._tid_names[tid] = name_update
             self._events.append(ev)
         return ev
 
@@ -108,6 +136,10 @@ class Tracer:
     def clear(self):
         with self._lock:
             self._events.clear()
+            # thread names must reset with the events: a recycled thread
+            # ident would otherwise label a later span's track with a
+            # dead thread's name in the chrome export
+            self._tid_names.clear()
 
     def __len__(self):
         with self._lock:
@@ -127,15 +159,34 @@ class Tracer:
 
     # -- chrome://tracing export -------------------------------------------
     def chrome_events(self, cat: Optional[str] = None) -> List[dict]:
-        out = []
+        """Span ("X") events under this process's REAL pid, prefixed with
+        "M" metadata naming the process and its threads — required for a
+        multi-process merge to render as distinct named tracks."""
+        pid = os.getpid()
+        spans = []
+        tids = set()
         for e in self.events(cat=cat):
-            ev = {"name": e.name, "ph": "X", "pid": 0, "tid": e.tid,
+            ev = {"name": e.name, "ph": "X", "pid": pid, "tid": e.tid,
                   "ts": int(e.ts * 1e6), "dur": int(e.dur * 1e6),
                   "cat": e.cat}
             if e.args or e.depth:
                 ev["args"] = dict(e.args, depth=e.depth)
-            out.append(ev)
-        return out
+            spans.append(ev)
+            tids.add(e.tid)
+        meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                 "args": {"name": get_process_name()}}]
+        # record-time names first (threads may have exited since), live
+        # threads as a fallback for spans recorded with an explicit tid
+        thread_names = {t.ident: t.name for t in threading.enumerate()
+                        if t.ident is not None}
+        with self._lock:   # a recording thread may be inserting a new tid
+            thread_names.update(self._tid_names)
+        for tid in sorted(tids):
+            name = thread_names.get(tid)
+            if name:
+                meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                             "tid": tid, "args": {"name": name}})
+        return meta + spans
 
     def export_chrome(self, path: str, cat: Optional[str] = None) -> str:
         """Write the ring as chrome://tracing JSON (reference
@@ -151,3 +202,85 @@ _tracer = Tracer()
 
 def get_tracer() -> Tracer:
     return _tracer
+
+
+# -- multi-process merge (fluid-xray) ---------------------------------------
+
+def merge_chrome_traces(paths: Sequence[str],
+                        out_path: Optional[str] = None
+                        ) -> Tuple[dict, dict]:
+    """Stitch per-process chrome-trace files into ONE timeline.
+
+    Every "X" span of every input survives verbatim (the caller can —
+    and chaos drills do — fail hard when `spans_out != spans_in`).
+    Process identity is kept distinct: if two files claim the same pid
+    but different process names (a restarted worker recycling a pid, or
+    two single-process drills merged after the fact), the later file's
+    events are remapped onto a fresh synthetic pid. Metadata ("M")
+    events are deduplicated per (pid, name, tid).
+
+    Returns (merged_doc, stats) where stats carries per-file and total
+    span counts; `out_path` additionally writes the merged JSON."""
+    merged_meta: List[dict] = []
+    merged_spans: List[dict] = []
+    seen_meta = set()
+    pid_owner: Dict[int, str] = {}      # pid -> process name that owns it
+    used_pids = set()
+    stats = {"files": {}, "spans_in": 0, "spans_out": 0, "processes": []}
+    for path in paths:
+        with open(path) as f:
+            doc = json.load(f)
+        events = doc.get("traceEvents", [])
+        # span budget counted straight off the LOADED file, independent
+        # of the transform loop below — so the spans_out gate actually
+        # catches a future merge change that filters events
+        n_spans = sum(1 for ev in events if ev.get("ph") != "M")
+        pname = None
+        for ev in events:
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                pname = ev.get("args", {}).get("name")
+                break
+        pname = pname or os.path.basename(path)
+        # pid remap when a pid is already owned by a DIFFERENT process
+        remap: Dict[int, int] = {}
+
+        def _pid_of(ev):
+            pid = ev.get("pid", 0)
+            if pid in remap:
+                return remap[pid]
+            owner = pid_owner.get(pid)
+            if owner is not None and owner != pname:
+                new = pid
+                while new in used_pids:
+                    new += 1 << 20
+                remap[pid] = new
+                used_pids.add(new)
+                pid_owner[new] = pname
+                return new
+            pid_owner[pid] = pname
+            used_pids.add(pid)
+            return pid
+
+        for ev in events:
+            ev = dict(ev, pid=_pid_of(ev))
+            if ev.get("ph") == "M":
+                key = (ev["pid"], ev.get("name"), ev.get("tid"),
+                       json.dumps(ev.get("args", {}), sort_keys=True))
+                if key in seen_meta:
+                    continue
+                seen_meta.add(key)
+                merged_meta.append(ev)
+            else:
+                merged_spans.append(ev)
+        stats["files"][path] = {"process": pname, "spans": n_spans}
+        stats["spans_in"] += n_spans
+        if pname not in stats["processes"]:
+            stats["processes"].append(pname)
+    merged_spans.sort(key=lambda e: e.get("ts", 0))
+    doc = {"traceEvents": merged_meta + merged_spans,
+           "displayTimeUnit": "ms"}
+    stats["spans_out"] = len(merged_spans)
+    if out_path is not None:
+        with open(out_path, "w") as f:
+            json.dump(doc, f)
+    return doc, stats

@@ -1,0 +1,198 @@
+"""fluid-xray: cross-process distributed trace context.
+
+The round-8 tracer records spans, but every span lives in ONE process's
+ring: a pserver RPC shows up as a client-side wait in the trainer and an
+unrelated handler blip on the server, with nothing tying them together.
+This module adds the W3C Trace Context trio — a 128-bit ``trace_id``
+shared by every span of one logical operation, a 64-bit ``span_id`` per
+span, and the parent's span id — carried across the pserver RPC frame
+and the serving request path, so a trainer+pserver chaos drill renders
+as one timeline instead of N disconnected ones.
+
+Wire format follows the W3C ``traceparent`` header
+(``00-<trace_id:32hex>-<span_id:16hex>-01``); `to_wire`/`from_wire`
+wrap it in a plain dict so the pickle-framed pserver RPC and any future
+HTTP front-end serialize it the same way. A malformed or missing header
+degrades to "no remote parent" — never an error (legacy peers without
+the field keep interoperating).
+
+Context flows through a `contextvars.ContextVar`: `span()` nests
+naturally within a thread, and thread-crossing layers (MicroBatcher
+futures, RPC handler threads) propagate explicitly via
+`current()`/`activate()`. Emission is the caller's business to gate on
+the `observe` flag — this module only allocates ids and appends to the
+(bounded) tracer ring.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from contextvars import ContextVar
+from typing import Optional
+
+from . import tracer as _tracer
+
+_WIRE_KEY = "traceparent"
+_cv: ContextVar[Optional["SpanContext"]] = ContextVar("xray_ctx",
+                                                      default=None)
+
+
+class SpanContext:
+    """Identity of one span: (trace_id, span_id, parent_span_id)."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_id: Optional[str] = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+
+    def child(self) -> "SpanContext":
+        """New span in the SAME trace, parented here."""
+        return SpanContext(self.trace_id, new_span_id(), self.span_id)
+
+    def trace_args(self) -> dict:
+        """The span-identity fields every xray tracer event carries."""
+        args = {"trace_id": self.trace_id, "span_id": self.span_id}
+        if self.parent_id:
+            args["parent_span_id"] = self.parent_id
+        return args
+
+    def __repr__(self):
+        return (f"SpanContext(trace={self.trace_id}, span={self.span_id}, "
+                f"parent={self.parent_id})")
+
+    def __eq__(self, other):
+        return (isinstance(other, SpanContext)
+                and self.trace_id == other.trace_id
+                and self.span_id == other.span_id
+                and self.parent_id == other.parent_id)
+
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def current() -> Optional[SpanContext]:
+    """The active span context of this thread/task, or None."""
+    return _cv.get()
+
+
+def child_of(parent: Optional[SpanContext] = None,
+             inherit: bool = True) -> SpanContext:
+    """A fresh span context: child of `parent` (or of the ambient context
+    when `inherit`), else the root of a brand-new trace."""
+    if parent is None and inherit:
+        parent = current()
+    if parent is not None:
+        return parent.child()
+    return SpanContext(new_trace_id(), new_span_id(), None)
+
+
+@contextlib.contextmanager
+def activate(ctx: Optional[SpanContext]):
+    """Make `ctx` the ambient context for the body (server handlers
+    adopting a remote parent; executor threads adopting a request's)."""
+    token = _cv.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _cv.reset(token)
+
+
+@contextlib.contextmanager
+def span(name: str, cat: str = "xray", parent: Optional[SpanContext] = None,
+         **args):
+    """Timed span recorded into the tracer ring WITH trace identity.
+
+    Like `Tracer.span` but each event carries trace_id/span_id/
+    parent_span_id, and the new context is ambient for the body so
+    nested spans (and outbound RPCs) join the trace. The event is
+    recorded even when the body raises, tagged ``error=<type>``."""
+    ctx = child_of(parent)
+    ts = time.time()
+    t0 = time.perf_counter()
+    err = None
+    token = _cv.set(ctx)
+    try:
+        yield ctx
+    except BaseException as e:
+        err = type(e).__name__
+        raise
+    finally:
+        _cv.reset(token)
+        a = dict(args, **ctx.trace_args())
+        if err is not None:
+            a["error"] = err
+        _tracer.get_tracer().record(name, ts, time.perf_counter() - t0,
+                                    cat=cat, **a)
+
+
+def record_span(name: str, ctx: SpanContext, ts: float, dur: float,
+                cat: str = "xray", **args):
+    """Append an already-timed span under an explicit context (callers
+    that measured the region themselves, e.g. per-attempt RPC timing)."""
+    _tracer.get_tracer().record(name, ts, dur, cat=cat,
+                                **dict(args, **ctx.trace_args()))
+
+
+# -- wire format ------------------------------------------------------------
+
+def to_traceparent(ctx: SpanContext) -> str:
+    return f"00-{ctx.trace_id}-{ctx.span_id}-01"
+
+
+def parse_traceparent(value) -> Optional[SpanContext]:
+    """Parse a ``traceparent`` string; any malformation returns None (a
+    legacy or buggy peer must degrade to "no parent", never to an
+    error)."""
+    if not isinstance(value, str):
+        return None
+    parts = value.split("-")
+    if len(parts) != 4:
+        return None
+    _ver, trace_id, span_id, _flags = parts
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    return SpanContext(trace_id, span_id)
+
+
+def to_wire(ctx: SpanContext) -> dict:
+    return {_WIRE_KEY: to_traceparent(ctx)}
+
+
+def from_wire(meta) -> Optional[SpanContext]:
+    """Extract a remote parent context from an RPC frame's meta dict.
+    Missing/malformed -> None (legacy peer interop)."""
+    if not isinstance(meta, dict):
+        return None
+    return parse_traceparent(meta.get(_WIRE_KEY))
+
+
+# -- process naming (chrome-trace merge) ------------------------------------
+
+def set_process_name(name: str):
+    """Name this process in chrome-trace exports (`tools/telemetry_dump.py
+    --merge` stitches per-process files; the name is what perfetto shows
+    per track)."""
+    _tracer.set_process_name(name)
+
+
+def process_name() -> str:
+    return _tracer.get_process_name()
+
+
+def reset():
+    """Drop the ambient context of THIS thread (tests)."""
+    _cv.set(None)

@@ -29,7 +29,9 @@ import numpy as np
 
 from .. import flags as _flags
 from ..ark.retry import RetryPolicy
+from ..observe import flight as _flight
 from ..observe import metrics as _metrics
+from ..observe import xray as _xray
 from . import rpc
 
 
@@ -48,7 +50,13 @@ class PSClient:
     def __init__(self, endpoints: Sequence[str],
                  retry: Optional[RetryPolicy] = None,
                  deadline: Optional[float] = None,
-                 replicas: Optional[Dict[str, Sequence[str]]] = None):
+                 replicas: Optional[Dict[str, Sequence[str]]] = None,
+                 wire_trace: bool = True):
+        # fluid-xray: with `wire_trace` (and the `observe` flag on) each
+        # request frame carries a traceparent meta element so the server's
+        # handler span joins this client's trace. False restores the bare
+        # 2-tuple frame for legacy servers that reject a third element.
+        self.wire_trace = bool(wire_trace)
         self.endpoints = list(endpoints)
         self.retry = retry if retry is not None else RetryPolicy()
         self.deadline = deadline if deadline is not None \
@@ -152,26 +160,55 @@ class PSClient:
                          else self.deadline)
         obs = _flags.get_flag("observe")
         t0 = time.perf_counter() if obs else 0.0
+        # fluid-xray call context: ONE span for the logical call (child of
+        # the ambient trace, or the root of a fresh one). Every attempt —
+        # retries AND replica failovers — parents to it, so retries share
+        # the trace id with a new span per attempt, and a failover keeps
+        # the same parent span.
+        call_ctx = _xray.child_of() if obs else None
+        ts_wall = time.time() if obs else 0.0
         candidates = [endpoint]
         if cmd in self._READ_ONLY:
             candidates += [ep for ep in self.replicas.get(endpoint, ())
                            if ep != endpoint]
         last_err = None
-        for i, ep in enumerate(candidates):
-            try:
-                (status, value), tx, rx = self._call_one(
-                    ep, cmd, payload, _deadline, obs)
-                break
-            except (ConnectionError, EOFError, OSError) as e:
-                last_err = e
-                if i + 1 < len(candidates) and obs:
-                    _metrics.counter(
-                        "pserver_client_failovers_total",
-                        "reads rerouted to a replica endpoint").inc(
-                            cmd=cmd, frm=ep)
-                continue
-        else:
-            raise last_err
+        served_ep, call_outcome = endpoint, "failed"
+        try:
+            for i, ep in enumerate(candidates):
+                try:
+                    (status, value), tx, rx = self._call_one(
+                        ep, cmd, payload, _deadline, obs, call_ctx)
+                    served_ep = ep
+                    call_outcome = "ok" if status == "ok" else "err_reply"
+                    break
+                except (ConnectionError, EOFError, OSError) as e:
+                    last_err = e
+                    if i + 1 < len(candidates) and obs:
+                        _metrics.counter(
+                            "pserver_client_failovers_total",
+                            "reads rerouted to a replica endpoint").inc(
+                                cmd=cmd, frm=ep)
+                        _flight.note("rpc_failover", cmd=cmd, frm=ep,
+                                     to=candidates[i + 1],
+                                     error=type(e).__name__)
+                    continue
+            else:
+                if obs:
+                    _flight.note("rpc_outcome", cmd=cmd, endpoint=endpoint,
+                                 outcome="failed",
+                                 error=type(last_err).__name__)
+                raise last_err
+        finally:
+            # attribute the logical call to the endpoint that actually
+            # served it (after a failover that is the replica, not the
+            # dead primary) and tag how it ended — a postmortem timeline
+            # read top-down must not show a failed/rerouted call as a
+            # clean success on the primary
+            if call_ctx is not None:
+                _xray.record_span(f"ps_call:{cmd}", call_ctx, ts_wall,
+                                  time.perf_counter() - t0, cat="rpc",
+                                  cmd=cmd, endpoint=served_ep,
+                                  outcome=call_outcome)
         if obs:
             _metrics.counter(
                 "pserver_client_requests_total",
@@ -187,10 +224,14 @@ class PSClient:
                 "client-observed RPC latency").observe(
                     time.perf_counter() - t0, cmd=cmd)
         if status != "ok":
+            if obs:
+                _flight.note("rpc_outcome", cmd=cmd, endpoint=endpoint,
+                             outcome="err_reply", error=str(value)[:200])
             raise RuntimeError(f"pserver {endpoint} {cmd}: {value}")
         return value
 
-    def _call_one(self, endpoint, cmd, payload, deadline, obs):
+    def _call_one(self, endpoint, cmd, payload, deadline, obs,
+                  call_ctx=None):
         """The per-endpoint retry loop. Failure phases:
 
         - connect/send: the length-prefixed frame never reached the
@@ -198,6 +239,12 @@ class PSClient:
           safe to retry;
         - recv (incl. a deadline timeout): the server may have applied
           the request — only replayable commands retry.
+
+        fluid-xray: every ATTEMPT gets its own span (a fresh child of
+        `call_ctx`, so retries and failovers share one trace id with a
+        distinct span id per attempt); the attempt's context rides the
+        frame as a traceparent meta element, making the server handler
+        span its child.
         """
         policy = self.retry
         replay_ok = self._replayable(cmd, payload)
@@ -209,6 +256,17 @@ class PSClient:
         with ep_lock:  # one in-flight request per connection
             while True:
                 phase = "connect"
+                att_ctx = call_ctx.child() if call_ctx is not None else None
+                att_ts = time.time() if obs else 0.0
+                att_t0 = time.perf_counter() if obs else 0.0
+
+                def _att_span(outcome):
+                    if att_ctx is not None:
+                        _xray.record_span(
+                            f"rpc_client:{cmd}", att_ctx, att_ts,
+                            time.perf_counter() - att_t0, cat="rpc",
+                            cmd=cmd, endpoint=endpoint, attempt=attempt,
+                            outcome=outcome)
                 try:
                     # the connect itself honors the remaining deadline:
                     # rpc.connect's default 30 s would otherwise wedge a
@@ -221,13 +279,18 @@ class PSClient:
                         sock.settimeout(
                             max(0.01, deadline_at - time.monotonic()))
                     phase = "send"
-                    tx = rpc.send_msg(sock, (cmd, payload))
+                    frame = (cmd, payload)
+                    if att_ctx is not None and self.wire_trace:
+                        frame = (cmd, payload, _xray.to_wire(att_ctx))
+                    tx = rpc.send_msg(sock, frame)
                     phase = "recv"
                     reply, rx = rpc.recv_msg(sock, with_size=True)
                     if deadline_at is not None:
                         sock.settimeout(None)
+                    _att_span("ok")
                     return reply, tx, rx
                 except (ConnectionError, EOFError, OSError):
+                    _att_span(f"fail_{phase}")
                     self._drop_sock(endpoint)
                     safe = phase != "recv" or replay_ok
                     out_of_time = deadline_at is not None and \
@@ -240,12 +303,18 @@ class PSClient:
                                 "RPCs abandoned after exhausting retries "
                                 "(or unsafe to replay)").inc(
                                     cmd=cmd, phase=phase)
+                            _flight.note("rpc_gave_up", cmd=cmd,
+                                         endpoint=endpoint, phase=phase,
+                                         attempts=attempt + 1)
                         raise
                     if obs:
                         _metrics.counter(
                             "pserver_client_retries_total",
                             "RPC attempts replayed after a transport "
                             "failure").inc(cmd=cmd, phase=phase)
+                        _flight.note("rpc_retry", cmd=cmd,
+                                     endpoint=endpoint, phase=phase,
+                                     attempt=attempt)
                     delay = policy.backoff(attempt)
                     attempt += 1
                     if deadline_at is not None:

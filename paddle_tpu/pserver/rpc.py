@@ -12,6 +12,15 @@ gRPC+protobuf for this; here the wire format is length-prefixed pickles of
 (cmd, payload) tuples over TCP — numpy arrays serialize zero-copy via
 pickle protocol 5 buffers, and the stdlib socket layer keeps the runtime
 dependency-free.
+
+fluid-xray frame extension: a request frame MAY carry a third element,
+a meta dict — today `{"traceparent": "00-<trace>-<span>-01"}` (W3C
+trace context, observe/xray.py) — so client and server spans of one
+call share a trace id across processes. The server accepts both the
+2- and 3-tuple shapes (a legacy client without the field still
+interoperates); a client talking to a legacy SERVER sends the plain
+2-tuple (`PSClient(wire_trace=False)`, and no meta is ever attached
+while the `observe` flag is off). Replies stay (status, value) 2-tuples.
 """
 
 from __future__ import annotations

@@ -32,7 +32,9 @@ import numpy as np
 from .. import flags as _flags
 from ..ark import checkpoint as ark_ckpt
 from ..ark.liveness import EvictingBarrier, LeaseTable
+from ..observe import flight as _flight
 from ..observe import metrics as _metrics
+from ..observe import xray as _xray
 from . import rpc
 from .optim import make_optimizer
 
@@ -190,13 +192,39 @@ class ParameterServer:
                     # last reply per open connection (crash-recovery tests
                     # depend on stop() being a hard cut)
                     return
-                cmd, payload = msg
+                # fluid-xray frame: (cmd, payload[, meta]) — the optional
+                # meta dict carries the client attempt's traceparent.
+                # Legacy 2-tuple frames (no meta) keep working unchanged;
+                # frames LONGER than we understand (a future peer) keep
+                # the fields we know rather than killing the connection,
+                # and anything shorter gets a named error reply.
+                try:
+                    cmd, payload = msg[0], msg[1]
+                    meta = msg[2] if len(msg) >= 3 else None
+                except (TypeError, IndexError):
+                    rpc.send_msg(conn, ("err", "MalformedFrame: expected "
+                                        "(cmd, payload[, meta])"))
+                    continue
                 obs = _flags.get_flag("observe")
                 t0 = time.perf_counter() if obs else 0.0
+                wctx = _xray.from_wire(meta) if obs and meta else None
                 try:
-                    reply = self._dispatch(cmd, payload)
+                    if wctx is not None:
+                        # adopt the remote parent for the handler body so
+                        # the server span (and anything the handler emits)
+                        # lands in the CLIENT's trace
+                        with _xray.activate(wctx), \
+                                _xray.span(f"rpc_server:{cmd}", cat="rpc",
+                                           cmd=cmd,
+                                           endpoint=self.endpoint):
+                            reply = self._dispatch(cmd, payload)
+                    else:
+                        reply = self._dispatch(cmd, payload)
                 except Exception as e:  # surface server errors to the client
                     reply = ("err", f"{type(e).__name__}: {e}")
+                    if obs:
+                        _flight.note("rpc_handler_error", cmd=cmd,
+                                     error=f"{type(e).__name__}: {e}"[:200])
                 # handler latency measured BEFORE the reply send: sendall
                 # blocks on a slow-reading client and that network stall
                 # must not masquerade as handler time
@@ -391,6 +419,10 @@ class ParameterServer:
             logger.info("pserver %s: trainer %s readmitted after "
                         "heartbeat (lease %.1fs)", self.endpoint,
                         trainer_id, lease_s)
+            # lease transitions go to the black box unconditionally —
+            # they are rare and exactly what a postmortem wants
+            _flight.note("lease_readmit", trainer_id=int(trainer_id),
+                         endpoint=self.endpoint)
             if _flags.get_flag("observe"):
                 _metrics.counter(
                     "pserver_trainers_readmitted_total",
@@ -411,10 +443,19 @@ class ParameterServer:
                     "the sync barrier (world degrades to %d live "
                     "trainers)", self.endpoint, tid,
                     self._sync_barrier.live_parties)
+                _flight.note("lease_evict", trainer_id=tid,
+                             endpoint=self.endpoint,
+                             live_parties=self._sync_barrier.live_parties)
                 if _flags.get_flag("observe"):
                     _metrics.counter(
                         "pserver_trainers_evicted_total",
                         "trainers evicted on lease expiry").inc()
+                    # an eviction span on the timeline: zero-duration mark
+                    # in whatever trace the waiting arrival activated
+                    with _xray.span("lease_evict", cat="ark",
+                                    trainer_id=tid,
+                                    endpoint=self.endpoint):
+                        pass
 
     def _h_sync_apply(self, trainer_id=None):
         try:

@@ -24,7 +24,9 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from .. import flags as _flags
 from ..core import executor as core_exec
+from ..observe import xray as _xray
 from .client import PSClient
 
 # lazily-initialized sparse rows are uniform in this range (reference
@@ -143,6 +145,17 @@ class AsyncPSTrainer:
 
     # -- one async step ---------------------------------------------------
     def step(self, feed: Dict, fetch_list: Sequence) -> List[np.ndarray]:
+        # fluid-xray: one span per training step so the pull/compute/push
+        # RPC spans of this step (and their server-side halves) nest
+        # under one parent in the merged timeline
+        if _flags.get_flag("observe"):
+            with _xray.span("train_step", cat="train",
+                            trainer_id=self.trainer_id):
+                return self._step_impl(feed, fetch_list)
+        return self._step_impl(feed, fetch_list)
+
+    def _step_impl(self, feed: Dict, fetch_list: Sequence
+                   ) -> List[np.ndarray]:
         # 1. recv the freshest dense params
         self._recv_dense()
 
@@ -265,6 +278,15 @@ class SyncPSTrainer(AsyncPSTrainer):
         super().close()
 
     def step(self, feed: Dict, fetch_list: Sequence) -> List[np.ndarray]:
+        if _flags.get_flag("observe"):
+            with _xray.span("train_step", cat="train",
+                            trainer_id=self.trainer_id,
+                            batch_id=self._batch_id):
+                return self._step_impl(feed, fetch_list)
+        return self._step_impl(feed, fetch_list)
+
+    def _step_impl(self, feed: Dict, fetch_list: Sequence
+                   ) -> List[np.ndarray]:
         # 1. recv: params as of the LAST barrier (identical on every
         # trainer — the barrier ordered the previous batch's update
         # before any release)

@@ -26,20 +26,28 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Tuple
 
+from .. import flags as _flags
 from ..observe import metrics as _metrics
+from ..observe import xray as _xray
 from .bucketing import concat_requests, pad_rows, plan_request
 from .errors import (BadRequestError, DeadlineExceededError,
                      ModelUnavailableError, QueueFullError, ServeError)
 
 
 class _Request:
-    __slots__ = ("planned", "future", "deadline", "t_enq")
+    __slots__ = ("planned", "future", "deadline", "t_enq", "ctx", "ts_wall")
 
-    def __init__(self, planned, future, deadline):
+    def __init__(self, planned, future, deadline, ctx=None, ts_wall=0.0):
         self.planned = planned
         self.future = future
         self.deadline = deadline        # absolute monotonic s, or None
         self.t_enq = time.monotonic()
+        # fluid-xray (observe on): the request's span context, captured
+        # on the SUBMITTING thread so the whole queue->batch->de-mux
+        # lifecycle lands in the caller's trace even though it completes
+        # on the executor thread
+        self.ctx = ctx
+        self.ts_wall = ts_wall
 
 
 class MicroBatcher:
@@ -83,28 +91,43 @@ class MicroBatcher:
 
     def submit(self, feed, deadline_ms: Optional[float] = None) -> Future:
         """Plan, admit and enqueue one request; returns its Future."""
+        ctx = _xray.child_of() if _flags.get_flag("observe") else None
+        ts_wall = time.time() if ctx is not None else 0.0
+        t_sub = time.monotonic()
         # cheap pre-check BEFORE planning: under overload the fast-reject
         # must not pay plan_request's pad/cast array copies per bounced
         # request (the authoritative check re-runs under the lock below)
         if self._pending >= self._max_queue:
+            self._reject_span(ctx, ts_wall, t_sub, "queue_full")
             self._reject_full()
         ver = self._registry.get(self._name)
         planned = plan_request(ver.spec, ver.ladder, feed)
         fut: Future = Future()
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
-        req = _Request(planned, fut, deadline)
+        req = _Request(planned, fut, deadline, ctx, ts_wall)
         with self._cond:
             if self._closed:
+                self._reject_span(ctx, ts_wall, t_sub, "unavailable")
                 raise ModelUnavailableError(
                     f"model {self._name!r}: batcher is shut down")
             if self._pending >= self._max_queue:
+                self._reject_span(ctx, ts_wall, t_sub, "queue_full")
                 self._reject_full()
             self._queues.setdefault(planned.group_key, deque()).append(req)
             self._pending += 1
             self._m_depth.set(self._pending, model=self._name)
             self._cond.notify()
         return fut
+
+    def _reject_span(self, ctx, ts_wall, t_sub, outcome: str):
+        """Close the lifecycle span of a request rejected at admission —
+        rejections must be visible in the caller's trace, not only in
+        the serve_requests_total counter."""
+        if ctx is not None:
+            _xray.record_span("serve_request", ctx, ts_wall,
+                              time.monotonic() - t_sub, cat="serve",
+                              model=self._name, outcome=outcome)
 
     def _reject_full(self):
         self._m_rejects.inc(model=self._name, reason="queue_full")
@@ -124,9 +147,18 @@ class MicroBatcher:
         no longer race an InvalidStateError out of the executor thread."""
         if req.future.set_running_or_notify_cancel():
             self._m_requests.inc(model=self._name, outcome=outcome)
+            self._req_span(req, outcome)
             req.future.set_exception(exc)
         else:
             self._m_requests.inc(model=self._name, outcome="cancelled")
+
+    def _req_span(self, req: _Request, outcome: str, **args):
+        """Close the request's lifecycle span (submit -> resolution)."""
+        if req.ctx is not None:
+            _xray.record_span("serve_request", req.ctx, req.ts_wall,
+                              time.monotonic() - req.t_enq, cat="serve",
+                              model=self._name, outcome=outcome,
+                              rows=req.planned.rows, **args)
 
     # -- executor side ---------------------------------------------------
 
@@ -259,6 +291,7 @@ class MicroBatcher:
                 if r.planned.rows > max_rows:
                     # already RUNNING (claimed above) — safe to set
                     self._m_requests.inc(model=self._name, outcome="error")
+                    self._req_span(r, "error", error="BadRequestError")
                     r.future.set_exception(BadRequestError(
                         f"model {self._name!r}: request has "
                         f"{r.planned.rows} rows but a hot swap shrank "
@@ -279,9 +312,26 @@ class MicroBatcher:
             feeds, rows = concat_requests([r.planned for r in batch])
             target = ver.ladder.rows_rung(rows)
             padded = pad_rows(feeds, rows, target)
+            # fluid-xray batch span: the ONE prepared step serving these
+            # coalesced requests. Parented to the oldest request's trace
+            # (the one that waited longest for this batch); the other
+            # members are linked through `traces` and each request's own
+            # lifecycle span carries `batch_span` back to it.
+            bctx = None
+            if any(r.ctx is not None for r in batch):
+                parent = next(r.ctx for r in batch if r.ctx is not None)
+                bctx = _xray.child_of(parent)
+            ts_wall = time.time()
             t0 = time.perf_counter()
             fetches = ver.prepared.run(padded)
             dt = time.perf_counter() - t0
+            if bctx is not None:
+                _xray.record_span(
+                    "serve_batch", bctx, ts_wall, dt, cat="serve",
+                    model=self._name, requests=len(batch), rows=rows,
+                    padded_rows=target,
+                    traces=[r.ctx.trace_id for r in batch[:8]
+                            if r.ctx is not None])
             self._m_batch_latency.observe(dt * 1e6, model=self._name)
             self._m_occupancy.observe(len(batch), model=self._name)
             self._m_rows.observe(rows, model=self._name)
@@ -301,11 +351,15 @@ class MicroBatcher:
                 self._m_requests.inc(model=self._name, outcome="ok")
                 self._m_latency.observe((done - r.t_enq) * 1e6,
                                         model=self._name)
+                self._req_span(
+                    r, "ok",
+                    **({"batch_span": bctx.span_id} if bctx else {}))
                 r.future.set_result(outs)
         except Exception as e:
             for r in batch:
                 self._m_requests.inc(model=self._name, outcome="error")
                 if not r.future.done():
+                    self._req_span(r, "error", error=type(e).__name__)
                     r.future.set_exception(e)
 
     def reconfigure(self, batch_timeout_ms: Optional[float] = None,

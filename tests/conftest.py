@@ -77,6 +77,23 @@ def pytest_collection_modifyitems(config, items):
 
 
 @pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    """Reset every process-global telemetry store AFTER each test
+    (fluid-xray satellite): the metrics registry, tracer ring, steplog,
+    recompilation observatory, flight recorder, and ambient trace
+    context are shared process state — without this, tests could only
+    assert snapshot-and-delta. The `observe` flag is restored too, so a
+    test that enables it cannot leak emission into its neighbors."""
+    from paddle_tpu import flags, observe
+
+    prev_observe = flags.get_flag("observe")
+    yield
+    if flags.get_flag("observe") != prev_observe:
+        flags.set_flag("observe", prev_observe)
+    observe.reset_all()
+
+
+@pytest.fixture(autouse=True)
 def _fresh_programs():
     """Give every test fresh default programs + scope + name counter
     (reference tests use prog_scope decorators)."""

@@ -437,3 +437,95 @@ def test_cli_model_mode(capsys):
     out = capsys.readouterr().out
     assert rc == 0, out
     assert "0 error(s)" in out
+
+
+# ---------------------------------------------------------------------------
+# per-op cost model (fluid-xray): static FLOPs/bytes vs hand counts and
+# vs XLA's own compiled cost_analysis
+# ---------------------------------------------------------------------------
+
+def test_cost_model_fc_flops_hand_check():
+    from paddle_tpu.analysis import estimate_cost
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        y = layers.fc(input=x, size=16)          # mul [B,8]x[8,16] + add
+        report = estimate_cost(fluid.default_main_program(),
+                               {"x": (4, 8)})
+    by_type = report.by_type()
+    # 2*M*K*N for the matmul, one flop/elem for the bias add
+    assert by_type["mul"]["flops"] == 2 * 4 * 8 * 16
+    assert by_type["elementwise_add"]["flops"] == 4 * 16
+    assert report.total_flops == 2 * 4 * 8 * 16 + 4 * 16
+    # bytes: the mul moves x (4*8*4) + W (8*16*4) + out (4*16*4)
+    assert by_type["mul"]["bytes"] == (4 * 8 + 8 * 16 + 4 * 16) * 4
+    assert report.param_bytes == (8 * 16 + 16) * 4   # W + bias
+    assert report.unresolved == []
+
+
+def test_cost_model_movement_ops_are_zero_flops():
+    from paddle_tpu.analysis import estimate_cost
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = layers.data(name="x", shape=[4, 8], dtype="float32")
+        r = layers.reshape(x, shape=[-1, 32])
+        t = layers.transpose(r, perm=[1, 0])
+        layers.concat([t, t], axis=1)
+        report = estimate_cost(fluid.default_main_program(),
+                               {"x": (2, 4, 8)})
+    assert report.total_flops == 0
+    # ...but the bytes they move are still counted
+    assert report.total_bytes > 0
+
+
+def test_cost_model_report_table_and_dict_shape():
+    from paddle_tpu.analysis import estimate_cost
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        h = layers.fc(input=x, size=32, act="relu")
+        loss = layers.mean(layers.fc(input=h, size=4))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        report = estimate_cost(fluid.default_main_program(),
+                               {"x": (4, 8)})
+    d = report.as_dict(top_k=5)
+    assert d["total_flops"] == report.total_flops > 0
+    assert d["arithmetic_intensity"] > 0
+    assert len(d["top"]) == 5
+    shares = [o["flops_share"] for o in d["top"]]
+    assert shares == sorted(shares, reverse=True)
+    assert abs(sum(a["flops_share"] for a in d["by_type"].values())
+               - 1.0) < 0.01
+    # grad ops are costed (the 2x-forward rule gives them real weight)
+    assert any(t.endswith("_grad") and a["flops"] > 0
+               for t, a in d["by_type"].items())
+    table = report.table(k=5, step_time_s=0.001)
+    assert "GFLOPs" in table and "est_time" in table and "TOTAL:" in table
+
+
+def test_cost_model_total_agrees_with_xla_within_10pct():
+    """The acceptance gate: static FLOPs vs jax's compiled
+    cost_analysis() on the (scaled-down) book transformer."""
+    from paddle_tpu import models
+    from paddle_tpu.analysis import cost_model
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup), fluid.unique_name.guard():
+        feeds, fetches = models.transformer.build(
+            src_vocab_size=500, trg_vocab_size=500, seq_len=32, n_layer=2,
+            n_head=2, d_model=64, d_inner=128, dropout_rate=0.0,
+            is_test=True, fused_attention=False)
+        loss = fetches["loss"]
+    rng = np.random.RandomState(0)
+    feed = {k: rng.randint(1, 499, (4, 32)).astype(np.int64)
+            for k in ("src_word", "trg_word", "lbl_word")}
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    prepared = exe.prepare(main_p, fetch_list=[loss], scope=scope)
+    prepared.run(dict(feed))
+    static = cost_model.estimate_cost(
+        main_p, {k: v.shape for k, v in feed.items()}).total_flops
+    xla = cost_model.xla_flops(exe, scope, feed)
+    assert xla > 0
+    ratio = static / xla
+    assert 0.9 <= ratio <= 1.1, (
+        f"static {static:.4g} vs xla {xla:.4g}: ratio {ratio:.3f} "
+        f"outside the 10% honesty band")

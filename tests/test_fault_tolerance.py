@@ -596,13 +596,70 @@ def test_chaos_drill_cli(tmp_path):
     import sys
     for scenario in ("flaky_rpc", "pserver_kill", "ckpt_crash",
                      "sync_evict"):
+        # ckpt_crash records no RPC/executor spans of its own — passing
+        # --trace-out there pins the root-drill-span fallback that keeps
+        # the merge's spans_in > 0 gate satisfied for ANY scenario
+        extra = (["--trace-out", str(tmp_path / scenario / "traces")]
+                 if scenario == "ckpt_crash" else [])
         proc = subprocess.run(
             [sys.executable,
              os.path.join(os.path.dirname(__file__), "..", "tools",
                           "chaos_drill.py"),
              "--scenario", scenario, "--seed", "7",
-             "--workdir", str(tmp_path / scenario)],
+             "--workdir", str(tmp_path / scenario)] + extra,
             capture_output=True, text=True, timeout=600,
             env={**os.environ, "JAX_PLATFORMS": "cpu"})
         assert proc.returncode == 0, (scenario, proc.stdout[-2000:],
                                       proc.stderr[-2000:])
+        if extra:
+            assert (tmp_path / scenario / "traces"
+                    / "merged_trace.json").exists()
+
+
+@pytest.mark.slow
+def test_dist_trace_drill_merged_timeline_and_flight_dump(tmp_path):
+    """fluid-xray CI gate: a REAL 2-process trainer+pserver job, server
+    killed by SIGTERM mid-run. The merged chrome trace must be valid
+    JSON naming both processes with client and server RPC spans linked
+    under one trace id, and the dying server must have written a
+    flight-recorder dump."""
+    import json
+    import subprocess
+    import sys
+    out = tmp_path / "xray"
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "tools",
+                      "chaos_drill.py"),
+         "--scenario", "dist_trace", "--seed", "7",
+         "--workdir", str(tmp_path / "wd"), "--trace-out", str(out)],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+
+    with open(out / "merged_trace.json") as f:
+        doc = json.load(f)                      # valid JSON or bust
+    procs = {e["pid"]: e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert sorted(procs.values()) == ["pserver0", "trainer0"]
+
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    by_trace = {}
+    for e in spans:
+        tid = e.get("args", {}).get("trace_id")
+        if tid:
+            by_trace.setdefault(tid, set()).add(
+                (procs.get(e["pid"]), e["name"].split(":")[0]))
+    cross = [names for names in by_trace.values()
+             if {p for p, _ in names} == {"trainer0", "pserver0"}]
+    assert cross, "no trace id spans both processes"
+    # at least one linked trace shows the full client->server RPC chain
+    assert any({("trainer0", "ps_call"), ("trainer0", "rpc_client"),
+                ("pserver0", "rpc_server")} <= names
+               for names in cross), cross
+
+    with open(out / "flight_pserver0.json") as f:
+        fr = json.load(f)
+    assert fr["process"] == "pserver0"
+    assert str(fr["reason"]).startswith("signal")
+    assert any(e["kind"] == "signal" for e in fr["events"])

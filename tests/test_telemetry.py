@@ -80,6 +80,46 @@ def test_metrics_prometheus_exposition():
     assert "c_seconds_count 1" in text
 
 
+def test_histogram_prometheus_quantile_lines():
+    """fluid-xray satellite: the text exposition carries estimated
+    p50/p90/p99 summary lines next to the cumulative buckets."""
+    reg = obm.Registry()
+    h = reg.histogram("lat_seconds")
+    for v in range(1, 101):          # 0.001..0.100 s, uniform
+        h.observe(v / 1000.0, cmd="push")
+    q = h.quantiles(cmd="push")
+    # bucket-interpolated estimates of a uniform sample: generous bands,
+    # exact ordering
+    assert 0.02 <= q[0.5] <= 0.08
+    assert q[0.5] <= q[0.9] <= q[0.99] <= 0.1
+    text = reg.to_prometheus()
+    # a SEPARATE <name>_quantile gauge family (quantile samples on the
+    # bare name are only valid under TYPE summary — strict scrapers and
+    # promtool reject them on a histogram)
+    assert "# TYPE lat_seconds_quantile gauge" in text
+    for want in ('quantile="0.5"', 'quantile="0.9"', 'quantile="0.99"'):
+        assert f'lat_seconds_quantile{{cmd="push",{want}}}' in text, text
+    # a single-sample histogram reports that sample exactly (clamped to
+    # the observed envelope)
+    h2 = reg.histogram("one_seconds")
+    h2.observe(0.042)
+    assert h2.quantiles()[0.5] == pytest.approx(0.042)
+    assert h2.quantiles()[0.99] == pytest.approx(0.042)
+    # empty labelset -> no estimate, not a crash
+    assert h.quantiles(cmd="nope") is None
+
+
+def test_reset_all_is_exported_and_resets_the_world():
+    fluid.set_flag("observe", True)
+    observe.default_registry().counter("junk_total").inc()
+    observe.get_tracer().record("ev", time.time(), 0.001)
+    observe.flight.note("step", i=1)
+    observe.reset_all()
+    assert observe.default_registry().names() == []
+    assert len(observe.get_tracer()) == 0
+    assert len(observe.get_flight()) == 0
+
+
 def test_metrics_kind_mismatch_raises_and_threads_are_safe():
     reg = obm.Registry()
     reg.counter("m")
@@ -123,6 +163,8 @@ def test_tracer_nesting_and_ring_bound():
 def test_chrome_trace_roundtrip_has_required_fields(tmp_path):
     """Tier-1 CI check: the chrome://tracing export must round-trip
     through json.loads with every required event field present."""
+    import os
+
     tr = Tracer(capacity=64)
     with tr.span("phase_a", cat="host", note="x"):
         with tr.span("phase_b", cat="host"):
@@ -132,16 +174,23 @@ def test_chrome_trace_roundtrip_has_required_fields(tmp_path):
     with open(path) as f:
         doc = json.loads(f.read())
     assert doc["displayTimeUnit"] == "ms"
-    evs = doc["traceEvents"]
-    assert len(evs) == 2
-    for ev in evs:
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(spans) == 2
+    for ev in spans:
         for field in ("name", "ph", "pid", "tid", "ts", "dur", "cat"):
             assert field in ev, f"missing {field} in {ev}"
-        assert ev["ph"] == "X"
+        # fluid-xray: the REAL pid, so multi-process merges keep tracks
+        # distinct
+        assert ev["pid"] == os.getpid()
         assert isinstance(ev["ts"], int) and isinstance(ev["dur"], int)
-    by_name = {e["name"]: e for e in evs}
+    by_name = {e["name"]: e for e in spans}
     assert by_name["phase_b"]["dur"] >= 1500  # ~2ms in µs
     assert by_name["phase_b"]["args"]["parent"] == "phase_a"
+    # process_name metadata rides every export (merge needs it)
+    meta = [e for e in doc["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "process_name"]
+    assert len(meta) == 1 and meta[0]["pid"] == os.getpid()
+    assert meta[0]["args"]["name"]
 
 
 # ---------------------------------------------------------------------------

@@ -19,6 +19,18 @@ Scenarios (all seed-deterministic through ark.chaos):
     sync_evict    a sync trainer dies holding a heartbeat lease; PASS =
                   the barrier evicts it in lease-time (not sync_timeout)
                   and the surviving trainer's update applies once
+    dist_trace    a REAL 2-process trainer+pserver job (tools/
+                  ps_worker.py is the server process) killed by SIGTERM
+                  mid-run; PASS = the dead server left BOTH postmortem
+                  artifacts (chrome trace + flight-recorder JSON) and
+                  the merged timeline links client and server RPC spans
+                  under one trace id across the two processes
+
+`--trace-out DIR` (any scenario): every participating process writes its
+chrome trace file into DIR (`trace_<process>.json`) and the drill merges
+them into `DIR/merged_trace.json`; the drill FAILS if the merge drops
+spans. This is the fluid-xray "one coherent picture of a chaos drill"
+artifact — open the merged file in chrome://tracing or perfetto.
 
 The CI wrapper (`tests/test_fault_tolerance.py::test_chaos_drill_cli`)
 is marked `slow`, so tier-1 wall time is unaffected; run the drills
@@ -97,7 +109,7 @@ def _run_steps(tr, loss, batch, n):
     return out
 
 
-def drill_flaky_rpc(seed, workdir):
+def drill_flaky_rpc(seed, workdir, trace_out=None):
     fluid.set_flag("observe", True)
     obs_metrics.default_registry().reset()
     servers, tr, loss, batch = _fresh_world(seed)
@@ -123,7 +135,7 @@ def drill_flaky_rpc(seed, workdir):
             s.stop()
 
 
-def drill_pserver_kill(seed, workdir):
+def drill_pserver_kill(seed, workdir, trace_out=None):
     fluid.set_flag("observe", True)
     obs_metrics.default_registry().reset()
     # no-fault reference band
@@ -167,7 +179,7 @@ def drill_pserver_kill(seed, workdir):
             s.stop()
 
 
-def drill_ckpt_crash(seed, workdir):
+def drill_ckpt_crash(seed, workdir, trace_out=None):
     d = os.path.join(workdir, "ck")
     arrays = {"w": np.arange(12, dtype=np.float32)}
     ark.save_checkpoint(d, arrays, cursor={"step_id": 1},
@@ -199,7 +211,7 @@ def drill_ckpt_crash(seed, workdir):
            "previous checkpoint loads intact")
 
 
-def drill_sync_evict(seed, workdir):
+def drill_sync_evict(seed, workdir, trace_out=None):
     fluid.set_flag("observe", True)
     obs_metrics.default_registry().reset()
     srv = ParameterServer("127.0.0.1:0", trainers=2,
@@ -230,12 +242,100 @@ def drill_sync_evict(seed, workdir):
         srv.stop()
 
 
+def drill_dist_trace(seed, workdir, trace_out=None):
+    """2-process trainer+pserver job under SIGTERM (fluid-xray)."""
+    import json
+    import signal
+    import subprocess
+
+    from paddle_tpu.observe import xray
+
+    out = trace_out or workdir
+    os.makedirs(out, exist_ok=True)
+    fluid.set_flag("observe", True)
+    obs_metrics.default_registry().reset()
+    xray.set_process_name("trainer0")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "ps_worker.py")
+    proc = subprocess.Popen(
+        [sys.executable, worker, "--name", "pserver0", "--out", out],
+        stdout=subprocess.PIPE, text=True, env=env)
+    client = None
+    try:
+        line = (proc.stdout.readline() or "").strip()
+        _check(line.startswith("ENDPOINT "), f"server process up ({line})")
+        ep = line.split()[1]
+        client = PSClient([ep])
+        client.init_param(ep, "w", np.zeros(4, np.float32), "sgd", 0.1, {})
+        for _ in range(3):
+            client.push_grad(ep, "w", np.full(4, 0.1, np.float32))
+        client.heartbeat(ep, trainer_id=0, session="drill")
+        got = client.get_param(ep, "w")
+        _check(np.isfinite(np.asarray(got)).all(),
+               "RPCs served across processes")
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+        print(f"  SIGTERM'd pserver process (rc={rc})")
+        # the dying server must have left BOTH artifacts
+        _check(os.path.exists(os.path.join(out, "trace_pserver0.json")),
+               "server chrome trace dumped on SIGTERM")
+        fr_path = os.path.join(out, "flight_pserver0.json")
+        _check(os.path.exists(fr_path), "server flight recorder dumped")
+        with open(fr_path) as f:
+            fr = json.load(f)
+        _check(str(fr.get("reason", "")).startswith("signal"),
+               f"flight dump names the killer ({fr.get('reason')})")
+        _check(any(e.get("kind") == "signal" for e in fr["events"]),
+               "flight ring recorded the TERM")
+        # one post-kill call: its retries put fail_connect attempt spans
+        # (same trace id, distinct span ids) on the trainer timeline
+        try:
+            client.get_param(ep, "w")
+        except Exception:
+            pass
+    finally:
+        if client is not None:
+            client.close()
+        if proc.poll() is None:
+            proc.kill()
+        fluid.set_flag("observe", False)
+
+
 SCENARIOS = {
     "flaky_rpc": drill_flaky_rpc,
     "pserver_kill": drill_pserver_kill,
     "ckpt_crash": drill_ckpt_crash,
     "sync_evict": drill_sync_evict,
+    "dist_trace": drill_dist_trace,
 }
+
+
+def _export_and_merge(trace_out):
+    """Write THIS process's trace file into `trace_out`, merge every
+    per-process trace file found there, and fail unless every span
+    survived the merge."""
+    import glob
+    import json
+
+    from paddle_tpu.observe import get_tracer, merge_chrome_traces, xray
+
+    if xray.process_name().startswith("pid"):
+        xray.set_process_name("trainer0")
+    mine = os.path.join(trace_out, f"trace_{xray.process_name()}.json")
+    get_tracer().export_chrome(mine)
+    inputs = sorted(glob.glob(os.path.join(trace_out, "trace_*.json")))
+    merged_path = os.path.join(trace_out, "merged_trace.json")
+    doc, stats = merge_chrome_traces(inputs, out_path=merged_path)
+    with open(merged_path) as f:
+        json.load(f)   # the artifact must round-trip
+    _check(stats["spans_out"] == stats["spans_in"] and stats["spans_in"] > 0,
+           f"merged {stats['spans_in']} spans from {len(inputs)} "
+           f"process file(s), none dropped")
+    print(f"  merged timeline: {merged_path} "
+          f"(processes: {', '.join(stats['processes'])})")
+    return merged_path, stats
 
 
 def main():
@@ -245,13 +345,30 @@ def main():
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--workdir", default=None,
                     help="scratch dir (default: a fresh tempdir)")
+    ap.add_argument("--trace-out", default=None, metavar="DIR",
+                    help="write per-process chrome trace files + a merged "
+                         "timeline here; the drill fails if the merge "
+                         "drops spans")
     args = ap.parse_args()
     workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_drill_")
     os.makedirs(workdir, exist_ok=True)
     print(f"chaos drill: {args.scenario} (seed {args.seed})")
     t0 = time.monotonic()
     try:
-        SCENARIOS[args.scenario](args.seed, workdir)
+        if args.trace_out:
+            # root span around the whole scenario: the timeline shows
+            # the drill's extent, and scenarios that make no RPC/executor
+            # calls (ckpt_crash) still contribute >= 1 span to the merge
+            from paddle_tpu.observe import xray
+            with xray.span(f"drill:{args.scenario}", cat="drill",
+                           seed=args.seed):
+                SCENARIOS[args.scenario](args.seed, workdir,
+                                         trace_out=args.trace_out)
+            os.makedirs(args.trace_out, exist_ok=True)
+            _export_and_merge(args.trace_out)
+        else:
+            SCENARIOS[args.scenario](args.seed, workdir,
+                                     trace_out=args.trace_out)
     except DrillFailure as e:
         print(f"DRILL FAILED: {e}")
         return 1

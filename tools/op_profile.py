@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""op_profile: the per-op cost table of a model, next to measured step time.
+
+Static per-op FLOPs/bytes/memory from `analysis.cost_model` (the GDP-style
+cost view of the dataflow graph), attributed against the measured
+device_compute phase of a short observed run — so "which op is my step
+time" has an answer without a device profiler attached:
+
+    python tools/op_profile.py --model transformer --topk 15
+    python tools/op_profile.py --model mlp --json
+    python tools/op_profile.py --xla-check      # exit 1 if the static
+        # total disagrees with XLA's compiled cost_analysis() by >10%
+
+Models: mlp (tiny fc stack), transformer (book transformer, scaled-down
+config by default; --full-size for the real base config), resnet
+(ResNet-18-ish; conv rules). The est_time column is `flops_share x
+measured device_compute` — exact for a compute-bound step, an upper
+bound for a bandwidth-bound one (compare against the bytes column).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_mlp(fluid, layers, batch):
+    x = layers.data(name="x", shape=[64], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="int64")
+    h = layers.fc(input=x, size=128, act="relu")
+    h = layers.fc(input=h, size=64, act="relu")
+    pred = layers.fc(input=h, size=10, act="softmax")
+    loss = layers.mean(layers.cross_entropy(input=pred, label=y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    import numpy as np
+    feed = {"x": np.random.RandomState(0).randn(batch, 64)
+            .astype(np.float32),
+            "y": np.random.RandomState(1).randint(0, 10, (batch, 1))
+            .astype(np.int64)}
+    return loss, feed
+
+
+def build_transformer(fluid, layers, batch, full_size=False):
+    import numpy as np
+
+    from paddle_tpu import models
+    kw = {} if full_size else dict(
+        src_vocab_size=1000, trg_vocab_size=1000, seq_len=32, n_layer=2,
+        n_head=2, d_model=64, d_inner=128)
+    feeds, fetches = models.transformer.build(
+        dropout_rate=0.0, is_test=True, fused_attention=False, **kw)
+    seq = 256 if full_size else 32
+    vocab = 30000 if full_size else 1000
+    rng = np.random.RandomState(0)
+    feed = {k: rng.randint(1, vocab - 1, (batch, seq)).astype(np.int64)
+            for k in ("src_word", "trg_word", "lbl_word")}
+    return fetches["loss"], feed
+
+
+def build_resnet(fluid, layers, batch):
+    import numpy as np
+
+    from paddle_tpu import models
+    feeds, fetches = models.resnet.build(class_dim=10, depth=18,
+                                         data_format="NHWC")
+    loss = fetches["loss"]
+    fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {"image": rng.rand(batch, 224, 224, 3).astype(np.float32),
+            "label": rng.randint(0, 10, (batch, 1)).astype(np.int32)}
+    return loss, feed
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="per-op FLOPs/bytes cost table + measured step share")
+    ap.add_argument("--model", choices=("mlp", "transformer", "resnet"),
+                    default="transformer")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=3,
+                    help="observed steps for the measured time column")
+    ap.add_argument("--topk", type=int, default=15)
+    ap.add_argument("--full-size", action="store_true",
+                    help="transformer: the real base config (slow compile)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable compact summary on stdout")
+    ap.add_argument("--xla-check", action="store_true",
+                    help="compare the static total against XLA "
+                         "cost_analysis(); exit 1 beyond 10%%")
+    args = ap.parse_args(argv)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, observe
+    from paddle_tpu.analysis import cost_model
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup), fluid.unique_name.guard():
+        loss, feed = {
+            "mlp": build_mlp,
+            "transformer": lambda *a: build_transformer(
+                *a, full_size=args.full_size),
+            "resnet": build_resnet,
+        }[args.model](fluid, layers, args.batch)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+
+    fluid.set_flag("observe", True)
+    prepared = exe.prepare(main_p, fetch_list=[loss], scope=scope)
+    for _ in range(max(args.steps, 1)):
+        prepared.run(dict(feed))
+    summ = observe.get_steplog().phase_summary()
+    # measured device_compute per steady step (the binding step's compile
+    # rides inside device_compute — drop it via the mean of the rest when
+    # more than one step ran)
+    steps = [s for s in observe.get_steplog().recent(64)
+             if "bind" not in s.phases]
+    dev_s = (sum(s.phases.get("device_compute", 0.0) for s in steps)
+             / len(steps)) if steps else 0.0
+
+    report = cost_model.estimate_cost(
+        main_p, {k: v.shape for k, v in feed.items()})
+
+    xla = None
+    if args.xla_check or args.json:
+        try:
+            xla = cost_model.xla_flops(exe, scope, feed)
+        except Exception as e:
+            print(f"WARNING: xla cross-check failed ({e!r})",
+                  file=sys.stderr)
+
+    if args.json:
+        out = report.as_dict(args.topk)
+        out["model"] = args.model
+        out["batch"] = args.batch
+        out["measured_device_compute_us"] = round(dev_s * 1e6, 2)
+        out["observed_steps"] = summ["steps"]
+        if xla:
+            out["xla_flops"] = xla
+            out["xla_agreement"] = round(report.total_flops / xla, 4)
+        print(json.dumps(out, sort_keys=True))
+    else:
+        print(f"model={args.model} batch={args.batch} "
+              f"(measured device_compute "
+              f"{dev_s * 1e6:.0f} us/step over {len(steps)} steady steps)")
+        print(report.table(args.topk, step_time_s=dev_s or None))
+
+    if args.xla_check:
+        if not xla:
+            print("XLA-CHECK FAILED: no cost_analysis flops available",
+                  file=sys.stderr)
+            return 1
+        ratio = report.total_flops / xla
+        ok = 0.9 <= ratio <= 1.1
+        print(f"xla-check: static={report.total_flops:.4g} "
+              f"xla={xla:.4g} ratio={ratio:.3f} "
+              f"{'OK' if ok else 'OUTSIDE 10%'}", file=sys.stderr)
+        if not ok:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,17 @@ and never fail the assertion.
 Other output modes: --format json (default) | prom (Prometheus text
 exposition) | table (human summary); --trace PATH writes the unified
 chrome://tracing timeline (open in chrome://tracing or perfetto).
+
+Multi-process stitch (fluid-xray):
+
+    python tools/telemetry_dump.py --merge merged.json t0.json ps0.json
+
+merges per-process trace files (each written by `Tracer.export_chrome`
+in its own process, with its real pid + process_name metadata) into ONE
+timeline. Exit 1 if any span would be dropped — a merge that loses
+spans is a broken postmortem. Client and server halves of one RPC share
+a trace id (`args.trace_id`), so the merged file shows the cross-process
+call tree.
 """
 
 from __future__ import annotations
@@ -66,7 +77,30 @@ def main(argv=None):
                     default="json")
     ap.add_argument("--trace", metavar="PATH",
                     help="also write the chrome://tracing timeline here")
+    ap.add_argument("--merge", metavar="OUT",
+                    help="stitch per-process chrome trace files (the "
+                         "positional args) into OUT and exit; exit 1 if "
+                         "the merge would drop spans")
+    ap.add_argument("inputs", nargs="*",
+                    help="input trace files for --merge")
     args = ap.parse_args(argv)
+
+    if args.merge:
+        from paddle_tpu.observe.tracer import merge_chrome_traces
+        if not args.inputs:
+            print("--merge needs at least one input trace file",
+                  file=sys.stderr)
+            return 1
+        doc, stats = merge_chrome_traces(args.inputs, out_path=args.merge)
+        print(json.dumps(stats, indent=2, sort_keys=True))
+        if stats["spans_out"] != stats["spans_in"]:
+            print(f"MERGE DROPPED SPANS: {stats['spans_in']} in, "
+                  f"{stats['spans_out']} out", file=sys.stderr)
+            return 1
+        print(f"merged {stats['spans_in']} spans from "
+              f"{len(args.inputs)} file(s) -> {args.merge}",
+              file=sys.stderr)
+        return 0
 
     import jax
     jax.config.update("jax_platforms", "cpu")  # env var alone is overridden
