@@ -46,6 +46,10 @@ CLAIMS = {
     "flash_vs_unfused_seq4096": (1.30, 1.75),
     "stacked_lstm_examples_per_sec": (3_500, 15_000),
     "feeder_overlap_speedup_cpu_demo": (1.3, 2.3),
+    # round 12 (fluid-wire): int8 per-chunk codec on the dense sync-PS
+    # push path — 4x data minus per-chunk scale overhead; the acceptance
+    # floor is 2.0 (bf16 territory), the ceiling is the int8 theoretical
+    "wire_compression_x": (2.0, 4.05),
     # round 6: host dispatch overhead, prepared vs the pre-round-6 run()
     # path (tools/step_overhead_bench.py, CPU subprocess — host-side
     # python, backend-independent). The floor of 2.0 is the acceptance
@@ -275,109 +279,95 @@ def bench_stacked_lstm(fluid, models, jax, batch_size=64, seq_len=100,
     return batch_size * seq_len / dt, batch_size / dt
 
 
-def feeder_overlap_subprocess():
-    """Tunnel-immune AsyncFeeder proof: run tools/feeder_overlap_demo.py
-    in a SUBPROCESS on the CPU backend (this process already owns the TPU
-    backend). Through the dev tunnel an on-chip feeder A/B is noise —
-    round 3 recorded a meaningless 0.61x; the demo measures the overlap
-    property itself (I/O-bound producer hidden under per-step-synced
-    compute) with clean in-process timing."""
+def _tool_json(script, label, args=(), timeout=600):
+    """Shared CPU-subprocess segment runner: every sub-bench that owns no
+    TPU state runs as `python tools/<script>` in a subprocess (this
+    process already owns the TPU backend) and prints its record as the
+    last '{'-prefixed stdout line. Returns (record, returncode), or
+    (None, None) on any failure — the caller substitutes its sentinel
+    defaults, which check_claims flags as MEASUREMENT-FAILED."""
     import subprocess
 
     try:
         out = subprocess.run(
             [sys.executable, os.path.join(os.path.dirname(
-                os.path.abspath(__file__)), "tools",
-                "feeder_overlap_demo.py")],
-            capture_output=True, text=True, timeout=600)
+                os.path.abspath(__file__)), "tools", script)] + list(args),
+            capture_output=True, text=True, timeout=timeout)
         line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
-        return json.loads(line)
+        return json.loads(line), out.returncode
     except Exception as e:
-        print(f"WARNING: feeder overlap demo failed ({e!r})",
-              file=sys.stderr)
-        return {"feeder_overlap_speedup_cpu_demo": 0.0}
+        print(f"WARNING: {label} failed ({e!r})", file=sys.stderr)
+        return None, None
+
+
+def feeder_overlap_subprocess():
+    """Tunnel-immune AsyncFeeder proof: the demo measures the overlap
+    property itself (I/O-bound producer hidden under per-step-synced
+    compute) with clean in-process timing — through the dev tunnel an
+    on-chip feeder A/B is noise (round 3 recorded a meaningless 0.61x)."""
+    rec, _ = _tool_json("feeder_overlap_demo.py", "feeder overlap demo")
+    return rec if rec is not None else \
+        {"feeder_overlap_speedup_cpu_demo": 0.0}
 
 
 def step_overhead_subprocess():
-    """Host dispatch µs/step, prepared vs unprepared: run
-    tools/step_overhead_bench.py in a SUBPROCESS on the CPU backend (host
-    dispatch is backend-independent python, and this process already owns
-    the TPU backend — same isolation rationale as the feeder demo)."""
-    import subprocess
-
-    try:
-        out = subprocess.run(
-            [sys.executable, os.path.join(os.path.dirname(
-                os.path.abspath(__file__)), "tools",
-                "step_overhead_bench.py")],
-            capture_output=True, text=True, timeout=600)
-        line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
-        return json.loads(line)
-    except Exception as e:
-        print(f"WARNING: step overhead bench failed ({e!r})",
-              file=sys.stderr)
-        return {"step_overhead_us": 0.0, "step_overhead_us_unprepared": 0.0,
-                "step_overhead_reduction_x": 0.0}
+    """Host dispatch µs/step, prepared vs unprepared
+    (tools/step_overhead_bench.py — host dispatch is backend-independent
+    python)."""
+    rec, _ = _tool_json("step_overhead_bench.py", "step overhead bench")
+    return rec if rec is not None else \
+        {"step_overhead_us": 0.0, "step_overhead_us_unprepared": 0.0,
+         "step_overhead_reduction_x": 0.0}
 
 
 def op_cost_subprocess():
     """fluid-xray cost model: the per-op cost table of the (scaled-down)
-    book transformer, cross-checked against XLA's own cost_analysis, in
-    a CPU subprocess (static analysis + a 3-step observed run — backend-
-    independent python; same isolation rationale as the other CPU
-    sub-benches). The compact summary lands in the recorded JSON so every
-    bench round carries the cost-attribution story the fluid-planner
-    work will consume."""
-    import subprocess
-
-    try:
-        out = subprocess.run(
-            [sys.executable, os.path.join(os.path.dirname(
-                os.path.abspath(__file__)), "tools",
-                "op_profile.py"), "--model", "transformer", "--json"],
-            capture_output=True, text=True, timeout=600)
-        line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
-        rec = json.loads(line)
-        top = rec.get("top") or [{}]
-        return {
-            "op_cost_total_gflops": round(
-                rec.get("total_flops", 0.0) / 1e9, 4),
-            "op_cost_xla_agreement": rec.get("xla_agreement", 0.0),
-            "op_cost_arithmetic_intensity": round(
-                rec.get("arithmetic_intensity", 0.0), 2),
-            "op_cost_top_op": (f"{top[0].get('type')}:{top[0].get('out')}"
-                               f"={top[0].get('flops_share', 0.0):.0%}"
-                               if top[0] else ""),
-        }
-    except Exception as e:
-        print(f"WARNING: op cost profile failed ({e!r})", file=sys.stderr)
+    book transformer, cross-checked against XLA's own cost_analysis.
+    The compact summary lands in the recorded JSON so every bench round
+    carries the cost-attribution story the fluid-planner work will
+    consume."""
+    rec, _ = _tool_json("op_profile.py", "op cost profile",
+                        args=("--model", "transformer", "--json"))
+    if rec is None:
         return {"op_cost_total_gflops": 0.0, "op_cost_xla_agreement": 0.0}
+    top = rec.get("top") or [{}]
+    return {
+        "op_cost_total_gflops": round(
+            rec.get("total_flops", 0.0) / 1e9, 4),
+        "op_cost_xla_agreement": rec.get("xla_agreement", 0.0),
+        "op_cost_arithmetic_intensity": round(
+            rec.get("arithmetic_intensity", 0.0), 2),
+        "op_cost_top_op": (f"{top[0].get('type')}:{top[0].get('out')}"
+                           f"={top[0].get('flops_share', 0.0):.0%}"
+                           if top[0] else ""),
+    }
+
+
+def wire_bench_subprocess():
+    """fluid-wire numbers (tools/wire_bench.py — the pserver wire is host
+    TCP + numpy): the sync-PS dense push A/B — bytes/step raw vs on-wire,
+    the compression ratio (acceptance: >= 2.0), step-time both modes,
+    the sparse-row compression, and the quantized-vs-raw loss delta."""
+    rec, _ = _tool_json("wire_bench.py", "wire bench")
+    return rec if rec is not None else \
+        {"wire_bytes_per_step_raw": 0.0,
+         "wire_bytes_per_step_encoded": 0.0,
+         "wire_compression_x": 0.0}
 
 
 def serve_loadgen_subprocess():
-    """fluid-serve numbers: run tools/serve_loadgen.py in a SUBPROCESS
-    on the CPU backend (serving host mechanics — batching, bucketing,
-    swap — are backend-independent python around a prepared step, and
-    this process already owns the TPU backend; same isolation rationale
-    as the feeder demo). Nonzero exit = a steady-state recompile or a
-    failed request; the sentinel keeps that visible in the JSON."""
-    import subprocess
-
-    try:
-        out = subprocess.run(
-            [sys.executable, os.path.join(os.path.dirname(
-                os.path.abspath(__file__)), "tools",
-                "serve_loadgen.py"), "--duration", "6"],
-            capture_output=True, text=True, timeout=600)
-        line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
-        rec = json.loads(line)
-        if out.returncode != 0:
-            rec["serve_loadgen_rc"] = out.returncode
-        return rec
-    except Exception as e:
-        print(f"WARNING: serve loadgen failed ({e!r})", file=sys.stderr)
+    """fluid-serve numbers (tools/serve_loadgen.py — serving host
+    mechanics are backend-independent python around a prepared step).
+    Nonzero exit = a steady-state recompile or a failed request; the
+    sentinel keeps that visible in the JSON."""
+    rec, rc = _tool_json("serve_loadgen.py", "serve loadgen",
+                         args=("--duration", "6"))
+    if rec is None:
         return {"serve_p50_us": 0.0, "serve_p99_us": 0.0,
                 "serve_qps": 0.0, "serve_recompiles": -1}
+    if rc != 0:
+        rec["serve_loadgen_rc"] = rc
+    return rec
 
 
 def tpu_gated_tests():
@@ -765,6 +755,12 @@ def main():
          serve_p99_us=srv.get("serve_p99_us", 0.0),
          serve_qps=srv.get("serve_qps", 0.0),
          serve_recompiles=srv.get("serve_recompiles", -1))
+    # fluid-wire: quantized PS wire A/B (bytes/step raw vs encoded, sync-PS
+    # step time both modes, sparse-row compression, loss-delta neutrality)
+    _PARTIAL["extra"]["failure_stage"] = "wire_bench_subprocess"
+    _obs.flight.set_stage("wire_bench_subprocess")
+    wirebench = wire_bench_subprocess()
+    note(**wirebench)
     # the headline pair is drift-sensitive through the dev tunnel, and
     # the noise is ONE-SIDED: a stall can only lower a reading below the
     # true device rate, never raise it (the device cannot run faster
@@ -849,6 +845,21 @@ def main():
         "op_cost_arithmetic_intensity": opcost.get(
             "op_cost_arithmetic_intensity", 0.0),
         "op_cost_top_op": opcost.get("op_cost_top_op", ""),
+        # fluid-wire (CPU subprocess, sync-PS dense push A/B + sparse leg):
+        # bytes/step down >= 2x at a negligible loss delta is the headline
+        "wire_bytes_per_step_raw": wirebench.get(
+            "wire_bytes_per_step_raw", 0.0),
+        "wire_bytes_per_step_encoded": wirebench.get(
+            "wire_bytes_per_step_encoded", 0.0),
+        "wire_compression_x": wirebench.get("wire_compression_x", 0.0),
+        "wire_sync_ps_step_ms_raw": wirebench.get(
+            "wire_sync_ps_step_ms_raw", 0.0),
+        "wire_sync_ps_step_ms_quant": wirebench.get(
+            "wire_sync_ps_step_ms_quant", 0.0),
+        "wire_sparse_compression_x": wirebench.get(
+            "wire_sparse_compression_x", 0.0),
+        "wire_quant_loss_delta": wirebench.get(
+            "wire_quant_loss_delta", -1.0),
         # both readings behind the keep-the-max headline metrics, so the
         # recorded JSON preserves the spread (advisor r5)
         "transformer_base_wmt_tokens_per_sec_first": round(tok_unf_first, 0),

@@ -34,6 +34,7 @@ from .core.executor import (Executor, PreparedProgram, Scope,  # noqa: F401
 from .core.backward import append_backward, calc_gradient  # noqa: F401
 
 from . import ops  # noqa: F401  (registers all lowering rules)
+from . import wire  # noqa: F401  (fluid-wire codecs + comm_quant op)
 from . import layers  # noqa: F401
 from . import initializer  # noqa: F401
 from . import optimizer  # noqa: F401

@@ -106,7 +106,8 @@ class ProgramVerificationError(ValueError):
 
 
 # ---------------------------------------------------------------------------
-# TPU-specific lints (advisory: WARNINGs, never ERRORs)
+# TPU-specific lints (advisory WARNINGs — except comm-float64, which is a
+# contract violation at the wire boundary and rates an ERROR)
 # ---------------------------------------------------------------------------
 
 def lint_program(program: ir.Program,
@@ -115,6 +116,7 @@ def lint_program(program: ir.Program,
     """Backend-fit lints over a structurally valid program."""
     diags: List[Diagnostic] = []
     diags += _lint_float64(program)
+    diags += _lint_comm_float64(program)
     diags += _lint_feed_shape_hazards(program)
     diags += _lint_static_inference_feeds(program)
     if fetch_targets:
@@ -146,6 +148,35 @@ def _lint_float64(program: ir.Program) -> List[Diagnostic]:
                     "float64-on-tpu", Severity.WARNING,
                     f"attr dtype={dt!r}: TPUs have no native f64",
                     blk, i, op))
+    return diags
+
+
+def _lint_comm_float64(program: ir.Program) -> List[Diagnostic]:
+    """fluid-wire extension of the float64 lint to the WIRE contract: a
+    gradient reaching a quantized communication boundary (a
+    `comm_quant_dequant` op — wire/graph.py) with dtype float64 is an
+    ERROR, not advice. The wire codecs are float32-only (wire/codec.py
+    refuses f64 at runtime with the same message), an f64 gradient at an
+    int8/bf16 boundary means the program silently planned to throw away
+    ~45 bits while paying f64 compute upstream — a config mistake, never
+    an intentional trade."""
+    diags = []
+    for blk in program.blocks:
+        for i, op in enumerate(blk.ops):
+            if op.type != "comm_quant_dequant":
+                continue
+            for slot in ("Grad", "Residual"):
+                for name in op.input(slot):
+                    v = blk._find_var_recursive(name)
+                    if v is not None and v.dtype == "float64":
+                        diags.append(diag_for_op(
+                            "comm-float64", Severity.ERROR,
+                            f"{slot.lower()} var {name!r} is float64 at a "
+                            f"quantized communication boundary (codec "
+                            f"{op.attrs.get('codec', 'int8')!r}): the wire "
+                            f"contract is float32 — cast the model to "
+                            f"float32, or drop comm_quant for this "
+                            f"program", blk, i, op, var=name))
     return diags
 
 

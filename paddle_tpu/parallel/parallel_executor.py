@@ -61,6 +61,13 @@ class BuildStrategy:
         # and bf16 mixed precision for the MXU ops.
         self.sharding_rules = []
         self.amp = False
+        # fluid-wire: "int8" / "bf16" inserts comm_quant_dequant ops with
+        # persistent error feedback before every optimizer op
+        # (wire/graph.py), quantizing each dp shard's gradient
+        # contribution at the GSPMD all-reduce boundary — still ONE
+        # jitted steady-state program (zero extra recompiles). None (the
+        # default) keeps full-precision gradients.
+        self.comm_quant = None
 
 
 class ParallelExecutor:
@@ -92,6 +99,15 @@ class ParallelExecutor:
         self._last_key = None
         self._run_counter = 0
         self._replicated = NamedSharding(self._mesh, PartitionSpec())
+        # fluid-wire: rewrite BEFORE the first compile/bcast — the
+        # residual vars are materialized straight into this executor's
+        # scope (the startup program typically already ran) and ride
+        # _bcast_params onto the mesh like any other state
+        if getattr(self._build_strategy, "comm_quant", None):
+            from ..wire.graph import apply_comm_quant
+            apply_comm_quant(self._program,
+                             codec=self._build_strategy.comm_quant,
+                             scope=self._scope)
         self._bcast_params()
 
     # reference BCastParamsToDevices (parallel_executor.cc:204): replicate

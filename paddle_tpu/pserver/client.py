@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from .. import flags as _flags
+from .. import wire as _wire
 from ..ark.retry import RetryPolicy
 from ..observe import flight as _flight
 from ..observe import metrics as _metrics
@@ -45,18 +46,39 @@ class PSClient:
     sync barrier), transparently reconnects sockets that went stale
     across a pserver restart, and — for read-only commands — fails over
     to replica endpoints (`replicas={primary: [backup, ...]}`) when the
-    primary is gone."""
+    primary is gone.
+
+    Wire compression (fluid-wire): `comm_quant="int8"|"bf16"` sends
+    gradient pushes as codec-tagged payloads (wire/codec.py) with
+    per-tensor client-side error feedback, and moves sparse-table rows
+    quantized in both directions. The codec is NEGOTIATED per endpoint
+    (one `wire_caps` RPC, cached): a legacy server that answers with an
+    unknown-command error gets raw payloads — never corrupted frames —
+    mirroring the xray 2-tuple/3-tuple interop posture. Default None
+    keeps the wire byte-identical to pre-wire traffic."""
 
     def __init__(self, endpoints: Sequence[str],
                  retry: Optional[RetryPolicy] = None,
                  deadline: Optional[float] = None,
                  replicas: Optional[Dict[str, Sequence[str]]] = None,
-                 wire_trace: bool = True):
+                 wire_trace: bool = True,
+                 comm_quant: Optional[str] = None):
         # fluid-xray: with `wire_trace` (and the `observe` flag on) each
         # request frame carries a traceparent meta element so the server's
         # handler span joins this client's trace. False restores the bare
         # 2-tuple frame for legacy servers that reject a third element.
         self.wire_trace = bool(wire_trace)
+        cq = None if comm_quant in (None, "raw") else str(comm_quant)
+        if cq is not None and cq not in _wire.CODECS:
+            raise _wire.WireCodecError(
+                f"comm_quant must be one of {_wire.CODECS} or None, got "
+                f"{comm_quant!r}")
+        self.comm_quant = cq
+        self._feedback = _wire.ErrorFeedback()
+        self._wire_ok: Dict[str, bool] = {}   # endpoint -> negotiated?
+        # endpoint -> monotonic time before which an unreachable
+        # negotiation verdict is not retried (raw in the meantime)
+        self._wire_retry_at: Dict[str, float] = {}
         self.endpoints = list(endpoints)
         self.retry = retry if retry is not None else RetryPolicy()
         self.deadline = deadline if deadline is not None \
@@ -130,7 +152,7 @@ class PSClient:
     # whose send failed was never dispatched by the server.
     _IDEMPOTENT = frozenset({"get_param", "get_params", "prefetch",
                              "init_param", "init_table", "stats",
-                             "heartbeat", "save", "restore"})
+                             "heartbeat", "save", "restore", "wire_caps"})
 
     # strictly read-only commands: the ONLY ones allowed to fail over to
     # a replica endpoint. Idempotent-but-mutating commands (save,
@@ -323,6 +345,119 @@ class PSClient:
                     if delay:
                         time.sleep(delay)
 
+    # -- wire codec (fluid-wire) ------------------------------------------
+    def _codec_for(self, endpoint) -> Optional[str]:
+        """The codec to use toward `endpoint`: `comm_quant` when the
+        server advertises it (one cached `wire_caps` RPC per endpoint),
+        else None (raw). A legacy server answers `wire_caps` with an
+        unknown-command error reply — negotiate down to raw instead of
+        feeding tagged payloads to handlers that would misread them."""
+        if self.comm_quant is None:
+            return None
+        ok = self._wire_ok.get(endpoint)
+        if ok is None:
+            if self._wire_retry_at.get(endpoint, 0.0) > time.monotonic():
+                return None   # recent unreachable verdict: raw, no probe
+            outcome = "ok"
+            try:
+                # short-deadline probe: with the endpoint down, the probe
+                # must not burn the full retry/backoff budget in front of
+                # every call that could itself fail over to a replica
+                caps = self._call(endpoint, "wire_caps", _deadline=2.0)
+                ok = self.comm_quant in (caps or {}).get("codecs", ())
+                if not ok:
+                    outcome = "unsupported_codec"
+            except RuntimeError as e:
+                if "unknown pserver command" not in str(e):
+                    raise
+                ok, outcome = False, "legacy_raw"
+            except (ConnectionError, EOFError, OSError):
+                # the endpoint is unreachable right now: degrade THIS call
+                # to raw instead of raising — negotiation must never cost
+                # availability. In particular a READ against a dead
+                # primary still reaches its replica: the prefetch itself
+                # fails over (wire_caps deliberately does NOT — a
+                # replica's caps must not be attributed to the primary's
+                # endpoint key). Unlike legacy_raw/unsupported_codec this
+                # verdict is NOT cached: a transient failure (pserver
+                # restart mid-session — ark reconnects through those)
+                # must not silently disable compression for the rest of
+                # the session. A short cooldown amortizes the probe so a
+                # long outage doesn't pay it in front of every call.
+                ok, outcome = None, "unreachable"
+                self._wire_retry_at[endpoint] = time.monotonic() + 30.0
+            if ok is not None:
+                self._wire_ok[endpoint] = ok
+            if _flags.get_flag("observe"):
+                _metrics.counter(
+                    "pserver_wire_negotiations_total",
+                    "wire-codec negotiations per endpoint (legacy servers "
+                    "degrade to raw)").inc(endpoint=endpoint,
+                                           codec=self.comm_quant,
+                                           outcome=outcome)
+        return self.comm_quant if ok else None
+
+    def wire_state(self):
+        """Error-feedback residuals as npz-compatible arrays — merge into
+        an ark checkpoint's `arrays` and hand back to
+        `restore_wire_state` after resume to keep pushes bit-identical
+        to the uninterrupted run under `comm_quant` (the residual is
+        trainer-local, so the server-side shard snapshot cannot carry
+        it; see docs/COMMUNICATION.md §Checkpointing)."""
+        return self._feedback.state_dict()
+
+    def restore_wire_state(self, state) -> None:
+        self._feedback.load_state_dict(state)
+
+    @staticmethod
+    def _account_wire(cmd, raw_nbytes, enc_nbytes):
+        """Raw vs on-wire tensor bytes per command: compression ratio is
+        a first-class metric (observe-gated like every runtime emitter)."""
+        if not _flags.get_flag("observe"):
+            return
+        _metrics.counter(
+            _wire.RAW_BYTES_METRIC,
+            "tensor payload bytes before the wire codec, per command").inc(
+                raw_nbytes, cmd=cmd)
+        _metrics.counter(
+            _wire.ENCODED_BYTES_METRIC,
+            "tensor payload bytes after the wire codec (on-wire), per "
+            "command").inc(enc_nbytes, cmd=cmd)
+
+    def _push_grads_one(self, endpoint, cmd, grads, extra=None):
+        """Encode (negotiated codec + error feedback) and send one
+        per-endpoint grads dict. Residuals commit only after the call
+        returns — transport retries resend the SAME encoded bytes and a
+        caller-level retry re-encodes from the unchanged residual, so a
+        replayed frame can never double-apply feedback (wire/feedback.py
+        replay contract, drilled by chaos `quant_flaky_rpc`)."""
+        codec = self._codec_for(endpoint)
+        # sync pushes carry a (session, batch) identity: the residual
+        # commit dedups on it, exactly like the server's accumulation
+        tag = None
+        if extra and extra.get("batch_id") is not None:
+            tag = (extra.get("session"), extra.get("trainer_id"),
+                   extra["batch_id"])
+        wire_grads, commits = {}, []
+        raw_b = enc_b = 0
+        for name, g in grads.items():
+            g = np.asarray(g)
+            raw_b += g.nbytes
+            if codec is None or g.dtype != np.float32:
+                wire_grads[name] = g
+                enc_b += g.nbytes
+            else:
+                payload, commit = self._feedback.encode(
+                    (endpoint, name), g, codec, name=name, tag=tag)
+                wire_grads[name] = payload
+                enc_b += _wire.payload_nbytes(payload)
+                commits.append(commit)
+        self._account_wire(cmd, raw_b, enc_b)
+        out = self._call(endpoint, cmd, grads=wire_grads, **(extra or {}))
+        for commit in commits:
+            commit()
+        return out
+
     # -- dense ------------------------------------------------------------
     def init_param(self, endpoint, name, value, opt_type, lr, attrs):
         self._call(endpoint, "init_param", name=name,
@@ -333,19 +468,34 @@ class PSClient:
         return self._call(endpoint, "get_param", name=name)
 
     def push_grad(self, endpoint, name, grad):
-        self._call(endpoint, "push_grad", name=name, grad=np.asarray(grad))
+        grad = np.asarray(grad)
+        codec = self._codec_for(endpoint)
+        if codec is None or grad.dtype != np.float32:
+            self._account_wire("push_grad", grad.nbytes, grad.nbytes)
+            self._call(endpoint, "push_grad", name=name, grad=grad)
+            return
+        payload, commit = self._feedback.encode((endpoint, name), grad,
+                                                codec, name=name)
+        self._account_wire("push_grad", grad.nbytes,
+                           _wire.payload_nbytes(payload))
+        self._call(endpoint, "push_grad", name=name, grad=payload)
+        commit()
+
+    def _fanout_each(self, calls: Dict[str, object]) -> Dict[str, object]:
+        """Run one thunk per endpoint, endpoints in parallel (reference
+        AsyncSendVar/AsyncGetVar handle overlap, grpc_client.cc:66/:122).
+        Single-endpoint calls skip the pool."""
+        if len(calls) <= 1:
+            return {ep: fn() for ep, fn in calls.items()}
+        futs = {ep: self._pool.submit(fn) for ep, fn in calls.items()}
+        return {ep: f.result() for ep, f in futs.items()}
 
     def _fanout(self, cmd: str, payload_by_ep: Dict[str, dict]
                 ) -> Dict[str, object]:
-        """One RPC per endpoint, endpoints in parallel (reference
-        AsyncSendVar/AsyncGetVar handle overlap, grpc_client.cc:66/:122).
-        Single-endpoint calls skip the pool."""
-        if len(payload_by_ep) <= 1:
-            return {ep: self._call(ep, cmd, **payload)
-                    for ep, payload in payload_by_ep.items()}
-        futs = {ep: self._pool.submit(self._call, ep, cmd, **payload)
-                for ep, payload in payload_by_ep.items()}
-        return {ep: f.result() for ep, f in futs.items()}
+        return self._fanout_each(
+            {ep: (lambda ep=ep, payload=payload:
+                  self._call(ep, cmd, **payload))
+             for ep, payload in payload_by_ep.items()})
 
     def get_params_parallel(self, by_ep: Dict[str, List[str]]
                             ) -> Dict[str, Dict[str, np.ndarray]]:
@@ -354,8 +504,10 @@ class PSClient:
                              for ep, names in by_ep.items()})
 
     def push_grads_parallel(self, by_ep: Dict[str, Dict[str, np.ndarray]]):
-        self._fanout("push_grads",
-                     {ep: {"grads": grads} for ep, grads in by_ep.items()})
+        self._fanout_each(
+            {ep: (lambda ep=ep, grads=grads:
+                  self._push_grads_one(ep, "push_grads", grads))
+             for ep, grads in by_ep.items()})
 
     # -- sparse -------------------------------------------------------------
     def init_table(self, name, rows, width, dtype, init_low, init_high,
@@ -373,7 +525,9 @@ class PSClient:
         """Fetch rows for GLOBAL ids: split by id % n (reference
         split_ids_op), prefetch each shard, merge back in input order
         (reference merge_ids_op). ids must be non-empty (callers skip
-        empty batches)."""
+        empty batches). With `comm_quant` negotiated, the reply rows
+        arrive quantized (the embedding-row pull is the DeepFM-shape
+        bandwidth hog) and are decoded here."""
         ids = np.asarray(ids).reshape(-1)
         if ids.size == 0:
             raise ValueError(
@@ -386,22 +540,61 @@ class PSClient:
             if not mask.any():
                 continue
             local = ids[mask] // n
-            rows = self._call(ep, "prefetch", name=name, local_ids=local)
+            codec = self._codec_for(ep)
+            kwargs = dict(name=name, local_ids=local)
+            if codec is not None:
+                kwargs["codec"] = codec
+            try:
+                reply = self._call(ep, "prefetch", **kwargs)
+            except RuntimeError as e:
+                # degrade-on-evidence: prefetch is read-only and may have
+                # FAILED OVER to a replica that never negotiated — a
+                # pre-wire replica rejects the codec kwarg with a
+                # TypeError reply. Retry bare (raw is correct against
+                # every version) instead of surfacing a hard failure from
+                # a healthy replica, and DROP the cached verdict rather
+                # than pinning the endpoint raw: the reply may have come
+                # from the replica, and a replica's (lack of) caps must
+                # not be attributed to the primary's endpoint key. The
+                # next call re-negotiates wire_caps against the primary
+                # itself — a healthy wire-aware primary gets compression
+                # back, a genuinely legacy peer caches legacy_raw there.
+                if "codec" not in kwargs or \
+                        "keyword argument" not in str(e) or \
+                        "codec" not in str(e):
+                    raise
+                self._wire_ok.pop(ep, None)
+                del kwargs["codec"]
+                reply = self._call(ep, "prefetch", **kwargs)
+            rows = _wire.maybe_decode(reply)
+            self._account_wire("prefetch", rows.nbytes,
+                               _wire.payload_nbytes(reply))
             if out is None:
                 out = np.empty((ids.shape[0], rows.shape[1]), rows.dtype)
             out[mask] = rows
         return out
 
     def push_sparse_grad(self, name, ids: np.ndarray, row_grads: np.ndarray):
+        """Scatter row gradients to their shards; with `comm_quant`
+        negotiated the rows travel int8/bf16 (no error feedback on the
+        sparse path: the touched-row set changes every batch, so there is
+        no per-tensor residual stream to carry — abs-max per chunk keeps
+        the row update error at half an lsb)."""
         ids = np.asarray(ids).reshape(-1)
         n = len(self.endpoints)
         for i, ep in enumerate(self.endpoints):
             mask = (ids % n) == i
             if not mask.any():
                 continue
+            sub = np.asarray(row_grads)[mask]
+            codec = self._codec_for(ep)
+            payload = sub
+            if codec is not None and sub.dtype == np.float32:
+                payload = _wire.encode_tensor(sub, codec, name=name)
+            self._account_wire("push_sparse_grad", sub.nbytes,
+                               _wire.payload_nbytes(payload))
             self._call(ep, "push_sparse_grad", name=name,
-                       local_ids=ids[mask] // n,
-                       row_grads=np.asarray(row_grads)[mask])
+                       local_ids=ids[mask] // n, row_grads=payload)
 
     # -- sync mode (reference RunSyncLoop) ----------------------------------
     def push_grads_sync(self, by_ep: Dict[str, Dict[str, np.ndarray]],
@@ -414,12 +607,14 @@ class PSClient:
         duplicate accumulation when a partially-failed batch is retried.
         `session` identifies the trainer PROCESS; a restarted trainer
         sends a fresh nonce so its restarted id sequence is accepted."""
-        self._fanout("push_grads_sync",
-                     {ep: ({"grads": grads} if batch_id is None else
-                           {"grads": grads, "batch_id": int(batch_id),
-                            "trainer_id": int(trainer_id),
-                            "session": session})
-                      for ep, grads in by_ep.items()})
+        extra = {} if batch_id is None else {
+            "batch_id": int(batch_id), "trainer_id": int(trainer_id),
+            "session": session}
+        self._fanout_each(
+            {ep: (lambda ep=ep, grads=grads:
+                  self._push_grads_one(ep, "push_grads_sync", grads,
+                                       dict(extra)))
+             for ep, grads in by_ep.items()})
 
     def sync_apply(self, endpoints: Sequence[str],
                    trainer_id: Optional[int] = None):

@@ -21,6 +21,17 @@ call share a trace id across processes. The server accepts both the
 interoperates); a client talking to a legacy SERVER sends the plain
 2-tuple (`PSClient(wire_trace=False)`, and no meta is ever attached
 while the `observe` flag is off). Replies stay (status, value) 2-tuples.
+
+fluid-wire payload extension: tensor values inside a payload MAY be
+codec-tagged dicts instead of bare ndarrays (wire/codec.py — int8
+per-chunk abs-max or bf16, `{"__wire__": 1, "codec": ..., "data": ...}`)
+so gradient pushes and sparse-row pulls travel 2-4x smaller. The frame
+layer here is codec-agnostic: tagged payloads are plain containers of
+numpy arrays, already admitted by the restricted unpickler below. Raw
+ndarrays remain the default wire shape — a client only sends tagged
+payloads to a server that advertised them via the `wire_caps` command
+(legacy servers answer unknown-command and the client degrades to raw,
+the same interop posture as the xray meta element).
 """
 
 from __future__ import annotations

@@ -30,6 +30,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from .. import flags as _flags
+from ..wire import codec as _wire_codec
 from ..ark import checkpoint as ark_ckpt
 from ..ark.liveness import EvictingBarrier, LeaseTable
 from ..observe import flight as _flight
@@ -285,9 +286,14 @@ class ParameterServer:
             return ("ok", self._dense[name].copy())
 
     def _h_push_grad(self, name, grad):
-        """Barrierless: apply immediately (RunAsyncLoop semantics)."""
+        """Barrierless: apply immediately (RunAsyncLoop semantics).
+        fluid-wire: the grad may arrive as a codec-tagged payload — it is
+        DEQUANTIZED here, before the optimizer applies (the server-side
+        half of the wire contract); raw arrays pass through unchanged, so
+        legacy clients interoperate."""
+        g = _wire_codec.maybe_decode(grad)  # decode outside the lock
         with self._lock(name):
-            self._optim[name].dense(self._dense[name], np.asarray(grad))
+            self._optim[name].dense(self._dense[name], g)
         return ("ok", None)
 
     def _h_get_params(self, names):
@@ -300,10 +306,25 @@ class ParameterServer:
         return ("ok", out)
 
     def _h_push_grads(self, grads):
-        for n, g in grads.items():
+        # decode EVERY tensor before applying ANY (and outside the
+        # locks): async pushes have no batch-id dedup, so a malformed
+        # frame must reject the whole push — a partial apply would be
+        # re-applied by the caller's retry
+        decoded = [(n, _wire_codec.maybe_decode(g))
+                   for n, g in grads.items()]
+        for n, dec in decoded:
             with self._lock(n):
-                self._optim[n].dense(self._dense[n], np.asarray(g))
+                self._optim[n].dense(self._dense[n], dec)
         return ("ok", None)
+
+    # -- wire negotiation (fluid-wire) ------------------------------------
+    def _h_wire_caps(self):
+        """Advertise the payload codecs this server decodes. A quantizing
+        client calls this once per endpoint; a LEGACY server answers with
+        an unknown-command error instead, which the client reads as
+        'negotiate down to raw' — mixed versions interoperate, never
+        corrupt."""
+        return ("ok", {"codecs": list(_wire_codec.CODECS), "version": 1})
 
     # -- sparse tables ----------------------------------------------------
     def _h_init_table(self, name, local_rows, width, dtype, init_low,
@@ -316,17 +337,23 @@ class ParameterServer:
                 self._opt_cfg[name] = (opt_type, float(lr), dict(attrs or {}))
         return ("ok", None)
 
-    def _h_prefetch(self, name, local_ids):
+    def _h_prefetch(self, name, local_ids, codec=None):
         """Row fetch by LOCAL ids (client did the id%N sharding split,
-        reference prefetch op + split_ids_op)."""
+        reference prefetch op + split_ids_op). `codec` (fluid-wire,
+        negotiated clients only) returns the rows as a quantized tagged
+        payload — embedding-row pulls are the recsys bandwidth hog."""
         with self._lock(name):
-            return ("ok", self._sparse[name].get(np.asarray(local_ids)))
+            rows = self._sparse[name].get(np.asarray(local_ids))
+        if codec and codec != "raw" and rows.dtype == np.float32:
+            return ("ok", _wire_codec.encode_tensor(rows, codec, name=name))
+        return ("ok", rows)
 
     def _h_push_sparse_grad(self, name, local_ids, row_grads):
+        rows = _wire_codec.maybe_decode(row_grads)  # decode outside lock
         with self._lock(name):
             table = self._sparse[name]
             self._optim[name].sparse(table.value, np.asarray(local_ids),
-                                     np.asarray(row_grads))
+                                     rows)
         return ("ok", None)
 
     # -- sync-mode barrier (reference RunSyncLoop batch barrier) -----------
@@ -355,6 +382,11 @@ class ParameterServer:
         at 0 under a new session, which resets its watermark — its pushes
         must accumulate, not be dropped as stale duplicates. Untagged
         pushes keep the legacy accumulate-always behavior."""
+        # fluid-wire: dequantize tagged payloads BEFORE taking the pending
+        # lock — the decode is O(gradient bytes) and must not serialize
+        # concurrent trainers' pushes (the rare deduplicated replay just
+        # wastes one decode). The pending sum stays full-precision f32.
+        decoded = {n: _wire_codec.maybe_decode(g) for n, g in grads.items()}
         with self._pending_lock:
             if batch_id is not None:
                 if session is not None and \
@@ -376,8 +408,7 @@ class ParameterServer:
                 if key in self._sync_pending_from:
                     return ("ok", "duplicate: push already accumulated")
                 self._sync_pending_from.add(key)
-            for n, g in grads.items():
-                g = np.asarray(g)
+            for n, g in decoded.items():
                 self._pending[n] = (g if n not in self._pending
                                     else self._pending[n] + g)
         return ("ok", None)

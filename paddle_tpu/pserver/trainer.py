@@ -41,7 +41,12 @@ class AsyncPSTrainer:
         self.exe = exe
         self.scope = scope or core_exec.global_scope()
         self.program = program or transpiler.get_trainer_program()
-        self.client = PSClient(transpiler._pserver_endpoints)
+        # fluid-wire: the transpiler config's comm_quant rides into the
+        # client so pserver pushes/pulls travel quantized (negotiated per
+        # endpoint; legacy servers degrade to raw)
+        self.client = PSClient(
+            transpiler._pserver_endpoints,
+            comm_quant=getattr(transpiler.config, "comm_quant", None))
         self.trainer_id = transpiler._trainer_id
         # tables sharing any ids feed must share one uniq/remap (a fed ids
         # var can only hold ONE remapping) — group them transitively

@@ -79,6 +79,17 @@ class DistributeTranspilerConfig:
     # needs static shapes; reference prefetch fetched exactly the batch's
     # unique ids — here they are padded to this cap)
     sparse_prefetch_cap = 2048
+    # fluid-wire communication compression (EQuARX-grounded, PAPERS.md):
+    # None/"raw" keeps full-precision traffic. "int8" / "bf16" quantizes
+    # BOTH distribution surfaces this transpiler plans: (a) on the
+    # collective (GSPMD) and hybrid dense paths, a comm_quant_dequant op
+    # with persistent error feedback is inserted before every optimizer
+    # op (wire/graph.py) so each dp shard's gradient contribution is
+    # quantized at the all-reduce boundary inside ONE jitted program;
+    # (b) on the pserver paths, the trainer's PSClient sends gradient
+    # pushes / sparse rows as codec-tagged payloads with client-side
+    # error feedback (wire/codec.py; negotiated — legacy servers get raw)
+    comm_quant = None
 
 
 class DistributeTranspiler:
@@ -119,6 +130,10 @@ class DistributeTranspiler:
             if not self._pserver_endpoints:
                 raise ValueError("hybrid mode needs pservers='host:port,...'")
             self._build_async_plan(dense_local=True)
+            # hybrid keeps dense optimizer ops in-graph: their gradients
+            # cross the GSPMD all-reduce, so the in-graph quantizer
+            # applies to them (the sparse half quantizes on the RPC wire)
+            self._apply_comm_quant(startup_program)
         elif self._sync_ps:
             if not self._pserver_endpoints:
                 raise ValueError(
@@ -126,11 +141,27 @@ class DistributeTranspiler:
             self._build_async_plan()
         elif sync_mode:
             self._annotate_distributed_tables()
+            self._apply_comm_quant(startup_program)
         else:
             if not self._pserver_endpoints:
                 raise ValueError("async mode needs pservers='host:port,...'")
             self._build_async_plan()
         return self
+
+    def _apply_comm_quant(self, startup_program=None):
+        """fluid-wire in-graph gradient quantization (config.comm_quant)
+        for the paths whose gradients cross GSPMD collectives. The
+        residual vars zero-init through the startup program, so the usual
+        build -> transpile -> run(startup) order materializes them; the
+        pserver paths need no program rewrite (the trainer's PSClient
+        quantizes on the RPC wire instead)."""
+        codec = getattr(self.config, "comm_quant", None)
+        if codec in (None, "raw"):
+            return
+        from ..wire.graph import apply_comm_quant
+        apply_comm_quant(
+            self._program, codec=codec,
+            startup_program=startup_program or ir.default_startup_program())
 
     # ------------------------------------------------------------------
     # async (barrierless) mode: host parameter-server plan

@@ -594,8 +594,8 @@ def test_chaos_drill_cli(tmp_path):
     """The heavy drills ride tools/chaos_drill.py; keep tier-1 lean."""
     import subprocess
     import sys
-    for scenario in ("flaky_rpc", "pserver_kill", "ckpt_crash",
-                     "sync_evict"):
+    for scenario in ("flaky_rpc", "quant_flaky_rpc", "pserver_kill",
+                     "ckpt_crash", "sync_evict"):
         # ckpt_crash records no RPC/executor spans of its own — passing
         # --trace-out there pins the root-drill-span fallback that keeps
         # the merge's spans_in > 0 gate satisfied for ANY scenario

@@ -491,6 +491,13 @@ SPECS.update({
     "fake_quantize_range_abs_max": Spec(
         inputs={"X": T(3, 4), "InScale": np.array([1.5], np.float32)},
         outs=("Out", "OutScale"), grad=[]),
+    # fluid-wire comm quantizer: lattice function (round), FD meaningless;
+    # the conservation property Out + ResidualOut == Grad + Residual and
+    # host-codec equality are pinned in tests/test_wire.py
+    "comm_quant_dequant": Spec(
+        inputs={"Grad": T(3, 7), "Residual": T(3, 7) * 0.01},
+        attrs={"codec": "int8", "chunk": 8},
+        outs=("Out", "ResidualOut"), grad=[]),
     # ---- breadth ops (extra_nn.py) ---------------------------------------
     "conv3d": Spec(inputs={"Input": T(1, 2, 5, 5, 5),
                            "Filter": T(3, 2, 3, 3, 3)},

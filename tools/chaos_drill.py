@@ -9,6 +9,12 @@ Scenarios (all seed-deterministic through ark.chaos):
     flaky_rpc     connections randomly die and stall under the trainer;
                   PASS = training completes, converges, and the retry
                   counters show the client actually recovered
+    quant_flaky_rpc  int8-quantized sync-PS pushes (fluid-wire) under
+                  close/truncate/delay chaos with batch retries; PASS =
+                  the final params are BIT-IDENTICAL to the no-fault
+                  quantized run (replayed frames dedup server-side and
+                  the error-feedback residual commits exactly once per
+                  logical batch — never double-applied on replay)
     pserver_kill  SIGKILL-equivalent pserver death mid-run; PASS = the
                   restarted server recovers its atomic shard checkpoint
                   and the run finishes inside the no-fault loss band
@@ -242,6 +248,99 @@ def drill_sync_evict(seed, workdir, trace_out=None):
         srv.stop()
 
 
+def drill_quant_flaky_rpc(seed, workdir, trace_out=None):
+    """fluid-wire: truncated/retried QUANTIZED frames recover BIT-SAFELY.
+
+    Two sync-PS runs push the same int8-quantized gradient sequence with
+    error feedback — one clean, one under chaos (close / truncate-mid-
+    frame / delay) with caller-level batch retries. The final server
+    params must be BIT-IDENTICAL: transport retries resend the same
+    encoded bytes, the server dedups replayed batches by (trainer,
+    batch, session), and the client's error-feedback residual commits
+    exactly once per logical batch (a replay never double-applies it)."""
+    from paddle_tpu.wire import ENCODED_BYTES_METRIC, RAW_BYTES_METRIC
+
+    fluid.set_flag("observe", True)
+    obs_metrics.default_registry().reset()
+    STEPS = 25
+    rng = np.random.RandomState(seed)
+    # odd length: the last int8 chunk is partial, so the padded tail of
+    # the codec is exercised on every frame
+    grads = [(rng.randn(257) * 0.1).astype(np.float32)
+             for _ in range(STEPS)]
+
+    def run(monkey=None):
+        srv = ParameterServer("127.0.0.1:0", trainers=1).start()
+        try:
+            c = PSClient([srv.endpoint], comm_quant="int8")
+            c.init_param(srv.endpoint, "w", np.zeros(257, np.float32),
+                         "sgd", lr=0.5, attrs={})
+            retried = 0
+            # Negotiate wire_caps BEFORE chaos starts: the lazy one-shot
+            # negotiation inside the first push would otherwise run under
+            # fault injection, and an exhausted-retry ConnectionError
+            # caches raw for the endpoint — the whole run would push
+            # float32 and fail the bit-identity check for a reason
+            # unrelated to the replay contract this drill proves.
+            if c._codec_for(srv.endpoint) != "int8":
+                raise DrillFailure("wire_caps negotiation did not land "
+                                   "on int8 before chaos")
+            if monkey is not None:
+                monkey.start()
+            try:
+                for i, g in enumerate(grads):
+                    for _ in range(30):
+                        try:
+                            c.push_grads_sync(
+                                {srv.endpoint: {"w": g}}, batch_id=i,
+                                trainer_id=0, session="drill")
+                            c.sync_apply([srv.endpoint])
+                            break
+                        except (RuntimeError, ConnectionError, OSError,
+                                EOFError):
+                            retried += 1
+                    else:
+                        raise DrillFailure(f"batch {i} never applied")
+            finally:
+                if monkey is not None:
+                    monkey.stop()
+            final = np.array(c.get_param(srv.endpoint, "w"))
+            c.close()
+            return final, retried
+        finally:
+            srv.stop()
+
+    try:
+        ref, _ = run()
+        print(f"  no-fault quantized run complete ({STEPS} batches)")
+        reg = obs_metrics.default_registry()
+        raw = reg.get(RAW_BYTES_METRIC).value(cmd="push_grads_sync")
+        enc = reg.get(ENCODED_BYTES_METRIC).value(cmd="push_grads_sync")
+        _check(enc < 0.5 * raw,
+               f"quantized frames on the wire ({raw:.0f} -> {enc:.0f} "
+               f"bytes, {raw / enc:.2f}x)")
+
+        monkey = chaos.ChaosMonkey(seed=seed, p_close=0.05,
+                                   p_truncate=0.05, p_delay=0.05,
+                                   delay_s=(0.001, 0.01))
+        got, retried = run(monkey)
+        _check(monkey.total_injected() > 0,
+               f"faults injected ({monkey.injected})")
+        _check(monkey.injected["truncate"] + monkey.injected["close"] > 0,
+               "at least one frame died mid-flight")
+        retries = obs_metrics.default_registry().get(
+            "pserver_client_retries_total")
+        transport_retries = retries.total() if retries else 0
+        _check(transport_retries + retried >= 1,
+               f"frames actually replayed (transport retries "
+               f"{transport_retries:.0f}, batch retries {retried})")
+        _check(np.array_equal(got, ref),
+               "chaos run BIT-IDENTICAL to the no-fault quantized run "
+               "(error-feedback residual never double-applied on replay)")
+    finally:
+        fluid.set_flag("observe", False)
+
+
 def drill_dist_trace(seed, workdir, trace_out=None):
     """2-process trainer+pserver job under SIGTERM (fluid-xray)."""
     import json
@@ -305,6 +404,7 @@ def drill_dist_trace(seed, workdir, trace_out=None):
 
 SCENARIOS = {
     "flaky_rpc": drill_flaky_rpc,
+    "quant_flaky_rpc": drill_quant_flaky_rpc,
     "pserver_kill": drill_pserver_kill,
     "ckpt_crash": drill_ckpt_crash,
     "sync_evict": drill_sync_evict,

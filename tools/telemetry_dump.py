@@ -24,8 +24,11 @@ compile-cache bug. Warmup compiles (`warmup`, `first_call`) are expected
 and never fail the assertion.
 
 Other output modes: --format json (default) | prom (Prometheus text
-exposition) | table (human summary); --trace PATH writes the unified
-chrome://tracing timeline (open in chrome://tracing or perfetto).
+exposition) | table (human summary — includes the fluid-wire
+per-command compression table, raw -> on-wire bytes with the ratio,
+whenever the run recorded pserver traffic); --trace PATH writes the
+unified chrome://tracing timeline (open in chrome://tracing or
+perfetto).
 
 Multi-process stitch (fluid-xray):
 
@@ -138,6 +141,11 @@ def main(argv=None):
                                 key=lambda kv: -kv[1]):
             print(f"  {phase:<16} {us:>12.1f} us total")
         print("recompiles:", summ["recompiles"]["counts"] or "none")
+        # fluid-wire: raw vs on-wire bytes per pserver command, with the
+        # compression ratio — present whenever the run moved PS traffic
+        from paddle_tpu.wire import wire_table
+        for line in wire_table(reg):
+            print(line)
         print("metrics:", ", ".join(reg.names()))
     else:
         print(json.dumps(observe.summary(), indent=2, sort_keys=True,
