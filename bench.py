@@ -560,6 +560,16 @@ def main():
     # (the per-step overhead is nanoseconds against ms-scale steps)
     import paddle_tpu.observe as _obs
     fluid.set_flag("observe", True)
+    # fluid-pulse: a live health plane for the whole bench run — the
+    # driver (or a human) can scrape /status /healthz /metrics while a
+    # segment is hung instead of waiting for the postmortem artifacts
+    try:
+        pulse_port = _obs.start_pulse(
+            int(os.environ.get("BENCH_PULSE_PORT", "0")))
+        _PARTIAL["extra"]["pulse_port"] = pulse_port
+    except Exception as e:
+        print(f"WARNING: pulse endpoint failed to start ({e!r})",
+              file=sys.stderr)
 
     def _recompile_counts():
         """Per-cause compile counts from the CUMULATIVE metrics counter
@@ -626,6 +636,24 @@ def main():
                 if delta:
                     _PARTIAL["extra"].setdefault("recompiles", {})[
                         label] = delta
+                # fluid-pulse memory observatory: the segment's peak HBM
+                # ESTIMATE (max over the programs it compiled), plus live
+                # device bytes whenever a real backend reports them (the
+                # CPU rehearsal degrades to estimate-only silently)
+                mem_obs = _obs.memory.get_observatory()
+                mem_peak = mem_obs.segment_peak(reset=True)
+                if mem_peak:
+                    _PARTIAL["extra"].setdefault(
+                        "mem_peak_est_bytes", {})[label] = int(mem_peak)
+                live = mem_obs.live_device_stats()
+                if live:
+                    _PARTIAL["extra"].setdefault(
+                        "mem_live_bytes", {})[label] = {
+                            "bytes_in_use": sum(
+                                d.get("bytes_in_use", 0) for d in live),
+                            "peak_bytes_in_use": sum(
+                                d.get("peak_bytes_in_use", 0)
+                                for d in live)}
             except Exception:
                 pass
             # re-arm a short breaker over the cleanup too: _release talks
@@ -878,7 +906,8 @@ def main():
     extra["failure_stage"] = (_PARTIAL["extra"].get("failed_stages")
                               or [None])[0]
     for k in ("failed_stages", "segment_wall_s", "step_phases_us",
-              "recompiles"):
+              "recompiles", "mem_peak_est_bytes", "mem_live_bytes",
+              "pulse_port"):
         if k in _PARTIAL["extra"]:
             extra[k] = _PARTIAL["extra"][k]
     extra["recompile_causes_total"] = _recompile_counts()

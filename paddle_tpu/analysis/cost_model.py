@@ -456,6 +456,84 @@ def estimate_cost(program: ir.Program,
     return CostReport(ops, param_bytes, unresolved)
 
 
+def estimate_peak_hbm(program: ir.Program,
+                      feed_shapes: Dict[str, Sequence[int]],
+                      default_dim: Optional[int] = None) -> dict:
+    """fluid-pulse memory observatory: per-program peak-HBM estimate from
+    the same concrete-shape walk `estimate_cost` uses.
+
+    Decomposition (all bytes):
+
+    - ``param_bytes``          persistable vars minus optimizer slots —
+                               identical to CostReport.param_bytes minus
+                               the slot component (their sum EQUALS
+                               CostReport.param_bytes, test-pinned)
+    - ``optimizer_slot_bytes`` persistable inputs of optimizer ops in
+                               slots other than Param/Grad/LearningRate
+                               (Velocity, Moment*, Beta*Pow, ...)
+    - ``grad_bytes``           non-persistable GRAD-suffixed vars — the
+                               dualed gradients live until applied
+    - ``activation_bytes``     every other non-persistable intermediate
+                               the walk resolved (forward activations a
+                               training step keeps for the backward)
+    - ``feed_bytes``           the fed batch itself
+    - ``peak_bytes``           the sum — an upper-bound-flavored estimate
+                               (XLA frees/fuses intermediates it can,
+                               and adds workspace/padding it must; see
+                               docs/OBSERVABILITY.md §memory for the
+                               band measured on the book models)
+    """
+    if default_dim is None:
+        default_dim = 1
+        for shape in feed_shapes.values():
+            if len(shape) and int(shape[0]) > 0:
+                default_dim = int(shape[0])
+                break
+    unresolved: List[str] = []
+    env = _concrete_env(program, feed_shapes, default_dim, unresolved)
+
+    slot_names: set = set()
+    for block in program.blocks:
+        for op in block.ops:
+            ins = op.inputs
+            if "Param" not in ins or "Grad" not in ins:
+                continue
+            for slot, names in ins.items():
+                if slot in ("Param", "Grad", "LearningRate"):
+                    continue
+                slot_names.update(n for n in names if n != EMPTY_VAR)
+
+    params = slots = grads = acts = feeds = 0.0
+    seen: set = set()
+    for blk in program.blocks:
+        for v in blk.vars.values():
+            if v.name in seen or v.shape == ():
+                continue
+            seen.add(v.name)
+            nb = _nbytes(env.get(v.name)
+                         or (_resolve(v.shape, default_dim), v.dtype))
+            if v.persistable:
+                if v.name in slot_names:
+                    slots += nb
+                else:
+                    params += nb
+            elif v.is_data or v.name in feed_shapes:
+                feeds += nb
+            elif ir.GRAD_SUFFIX in v.name:
+                grads += nb
+            else:
+                acts += nb
+    return {
+        "param_bytes": params,
+        "optimizer_slot_bytes": slots,
+        "grad_bytes": grads,
+        "activation_bytes": acts,
+        "feed_bytes": feeds,
+        "peak_bytes": params + slots + grads + acts + feeds,
+        "unresolved": len(unresolved),
+    }
+
+
 def xla_flops(exe, scope, feed_arrays) -> float:
     """Ground truth for the cross-check: FLOPs XLA counts for the largest
     step compiled in `exe` (the program must have run once with

@@ -692,6 +692,14 @@ class PreparedProgram:
                     tuple(self.fetch_names),
                     tuple(sorted(copts.items())) if copts else None,
                     source=self.telemetry_source, scope_uid=self.scope._uid)
+                if _flags.get_flag("observe"):
+                    # fluid-pulse memory observatory: a compile costs
+                    # seconds, the concrete-shape walk costs milliseconds
+                    # — estimate this program's peak HBM at the shapes it
+                    # is about to compile for (never raises)
+                    from ..observe import memory as _obs_memory
+                    _obs_memory.note_program(
+                        program, feed_arrays, source=self.telemetry_source)
                 stream = exe._stream_for(program._uid)
                 with jax.default_device(self._device):
                     entry = _CompiledProgram(

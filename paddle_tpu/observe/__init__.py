@@ -8,6 +8,14 @@ Three cooperating pieces (see docs/OBSERVABILITY.md):
   chrome://tracing export; absorbs the profiler's host-event table
 - `observe.steplog`  — per-run() StepStats phase timings + the
   recompilation observatory (every jit cache miss, with attributed cause)
+- `observe.xray`     — W3C trace contexts across processes (round 11)
+- `observe.flight`   — the crash flight recorder (round 11)
+- `observe.pulse`    — per-process HTTP health endpoint: /metrics,
+  /healthz, /readyz, /status, /flight (round 13, `start_pulse(port=0)`)
+- `observe.health`   — metric time-series + anomaly detectors firing
+  structured Alerts into the registry AND the flight ring (round 13)
+- `observe.memory`   — the HBM observatory: per-program peak estimates
+  vs live device memory stats (round 13)
 
 Emission from hot paths (Executor/PreparedProgram/ParallelExecutor steps,
 AsyncFeeder, pserver RPC) is gated on the `observe` flag:
@@ -23,12 +31,19 @@ events are recorded regardless — they are never hot and they are what
 from __future__ import annotations
 
 from .. import flags as _flags
-from . import flight, metrics, steplog, tracer, xray  # noqa: F401
+from . import flight, health, memory, metrics, pulse  # noqa: F401
+from . import steplog, tracer, xray  # noqa: F401
 from .flight import get_flight  # noqa: F401
+from .health import get_engine  # noqa: F401
 from .metrics import counter, default_registry, gauge, histogram  # noqa: F401
+from .pulse import start_pulse, stop_pulse  # noqa: F401
 from .steplog import (StepStats, get_steplog, observatory,  # noqa: F401
                       preseed_shapes, track_shapes)
 from .tracer import get_tracer, merge_chrome_traces  # noqa: F401
+
+# fluid-pulse: every flight-recorder dump carries the memory observatory
+# (an OOM/SIGTERM death must be attributable to who held the bytes)
+get_flight().add_section("memory", memory.get_observatory().flight_section)
 
 
 def enabled() -> bool:
@@ -46,15 +61,14 @@ def disable():
 
 def summary() -> dict:
     """One dict with everything a run left behind — what
-    tools/telemetry_dump.py prints and bench.py records."""
-    return {
-        "metrics": default_registry().snapshot(),
-        "steps": get_steplog().phase_summary(),
-        "recompiles": {
-            "counts": observatory().counts(),
-            "events": [e.as_dict() for e in observatory().events()],
-        },
-    }
+    tools/telemetry_dump.py prints and bench.py records. Derived from
+    pulse.status_document() (the live `/status` body) minus process
+    identity, so the dead- and live-process shapes CANNOT diverge —
+    one source of truth for the one-tool-reads-both contract."""
+    doc = pulse.status_document()
+    for k in ("pid", "process", "ts"):
+        doc.pop(k, None)
+    return doc
 
 
 def reset():
@@ -66,10 +80,15 @@ def reset():
 
 
 def reset_all():
-    """`reset()` plus the fluid-xray stores: flight-recorder ring +
-    stage, and this thread's ambient trace context. The tier-1 autouse
-    fixture calls this so tests stop sharing process-global telemetry
-    state (snapshot-and-delta assertions are no longer required)."""
+    """`reset()` plus the fluid-xray stores (flight-recorder ring +
+    stage, this thread's ambient trace context) and the fluid-pulse
+    plane (the HTTP server thread is STOPPED, the health engine and
+    memory observatory cleared). The tier-1 autouse fixture calls this
+    so tests stop sharing process-global telemetry state — and can
+    never leak a pulse thread."""
     reset()
     get_flight().clear()
     xray.reset()
+    pulse.stop_pulse()
+    health.reset()
+    memory.reset()

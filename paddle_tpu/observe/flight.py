@@ -32,10 +32,25 @@ import sys
 import threading
 import time
 import traceback
+import math
 from collections import deque
 from typing import Callable, List, Optional
 
 DEFAULT_CAPACITY = 512
+
+
+def json_safe(v):
+    """RFC 8259 has no NaN/Infinity but Python's json emits bare `NaN`
+    tokens — a postmortem (or /healthz body) carrying a non-finite
+    observed value must still parse in strict readers. Stringify
+    non-finite floats recursively."""
+    if isinstance(v, float) and not math.isfinite(v):
+        return repr(v)          # 'nan' / 'inf' / '-inf', as a string
+    if isinstance(v, dict):
+        return {k: json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [json_safe(x) for x in v]
+    return v
 
 
 class FlightRecorder:
@@ -48,6 +63,16 @@ class FlightRecorder:
         self._installed = False
         self._prev_excepthook = None
         self._dumped = threading.Event()
+        # named snapshot providers merged into every dump (fluid-pulse
+        # registers "memory" here so an OOM/SIGTERM death carries the
+        # HBM observatory). Providers survive clear() — they are wiring,
+        # not state.
+        self._sections: dict = {}
+
+    def add_section(self, name: str, fn: Callable):
+        """Merge `fn()` into every snapshot under `name`, best-effort (a
+        failing provider is dropped from that dump, never raises)."""
+        self._sections[name] = fn
 
     # -- recording --------------------------------------------------------
 
@@ -89,7 +114,7 @@ class FlightRecorder:
         from . import xray as _xray
         with self._lock:
             evs = list(self._events)
-        return {
+        doc = {
             "pid": os.getpid(),
             "process": _xray.process_name(),
             "dumped_at": time.time(),
@@ -97,6 +122,12 @@ class FlightRecorder:
             "failure_stage": self._stage,
             "events": evs,
         }
+        for name, fn in list(self._sections.items()):
+            try:
+                doc[name] = fn()
+            except Exception:
+                pass
+        return doc
 
     def dump(self, path: Optional[str] = None,
              reason: Optional[str] = None) -> Optional[str]:
@@ -106,7 +137,7 @@ class FlightRecorder:
         crash; returns the path written or None."""
         path = path or self._dump_path or "flight_recorder.json"
         try:
-            snap = self.snapshot(reason=reason)
+            snap = json_safe(self.snapshot(reason=reason))
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "w") as f:
                 json.dump(snap, f, indent=1, default=str)

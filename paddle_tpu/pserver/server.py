@@ -66,10 +66,16 @@ class _SparseTable:
 
 class ParameterServer:
     def __init__(self, endpoint: str, trainers: int = 1,
-                 sync_timeout: float = 120.0):
+                 sync_timeout: float = 120.0,
+                 pulse_port: Optional[int] = None):
         self.endpoint = endpoint
         self.trainers = trainers
         self.sync_timeout = sync_timeout
+        # fluid-pulse opt-in: start()/stop() manage the process's health
+        # endpoint and this server's lease-freshness check on it
+        # (requires the observe flag — start_pulse refuses otherwise)
+        self._pulse_port_req = pulse_port
+        self.pulse_port: Optional[int] = None
         self._dense: Dict[str, np.ndarray] = {}
         self._sparse: Dict[str, _SparseTable] = {}
         self._optim: Dict[str, object] = {}
@@ -121,7 +127,45 @@ class ParameterServer:
         t.start()
         self._threads.append(t)
         logger.info("pserver listening on %s", self.endpoint)
+        if self._pulse_port_req is not None:
+            from ..observe import health as _health
+            from ..observe import pulse as _pulse
+            self.pulse_port = _pulse.start_pulse(self._pulse_port_req)
+            _health.get_engine().register_check(
+                f"pserver_leases@{self.endpoint}", self._pulse_lease_check,
+                ready=True)
         return self
+
+    def _pulse_lease_check(self):
+        """fluid-pulse /healthz check: heartbeat-lease freshness. Unready
+        when a leaseholder's lease RECENTLY expired without the barrier
+        evicting it yet — the window where a dead trainer may still
+        count toward the sync world. Bounded: eviction only runs while
+        someone waits on the barrier, so a trainer that departed for
+        good (job finished, crash with no sync traffic) would otherwise
+        hold this server at 503 forever; past 3 lease periods it is
+        reported as `departed` detail, not unhealth. Expired-and-evicted
+        trainers are detail too (the world already degraded around
+        them)."""
+        snap = self._lease.snapshot()
+        evicted = self._sync_barrier.evicted
+        stale, departed = [], []
+        for t, rec in snap.items():
+            if rec["live"] or t in evicted:
+                continue
+            expired_for = -rec["expires_in_s"]
+            (stale if expired_for <= 3.0 * rec["lease_s"]
+             else departed).append(t)
+        detail = {
+            "leases": {str(t): {k: v for k, v in rec.items()
+                                if k != "session"}
+                       for t, rec in snap.items()},
+            "evicted": sorted(evicted),
+            "stale": sorted(stale),
+            "departed": sorted(departed),
+            "live_parties": self._sync_barrier.live_parties,
+        }
+        return (not stale, detail)
 
     def serve_forever(self):
         self.start()
@@ -133,6 +177,11 @@ class ParameterServer:
         unanswered, waiting clients see EOF/RST), and the endpoint's
         port frees up so a restarted server can bind it."""
         self._stop.set()
+        if self.pulse_port is not None:
+            from ..observe import health as _health
+            _health.get_engine().unregister_check(
+                f"pserver_leases@{self.endpoint}")
+            self.pulse_port = None
         if self._listener is not None:
             # shutdown BEFORE close: the accept-loop thread blocked in
             # accept() holds a kernel reference — close() alone leaves

@@ -26,8 +26,31 @@ import numpy as np
 
 from .. import flags as _flags
 from ..core import executor as core_exec
+from ..observe import health as _health
+from ..observe import metrics as _metrics
 from ..observe import xray as _xray
 from .client import PSClient
+
+
+def _note_step_health(user_outs, grads):
+    """fluid-pulse (observe on): land this step's loss and gradient norm
+    on the health plane's time-series via the registry emit path the
+    engine watches — food for the non-finite and grad-norm-spike
+    detectors. The fetched arrays are already on the host; the norm
+    accumulates per-tensor vdot scalars in the NATIVE dtype (no float64
+    copy of the model's gradients per step — the observe overhead
+    contract is cheap host scalars, not O(model-bytes) traffic)."""
+    if user_outs:
+        _health.note_loss_fetch(user_outs)
+    if grads:
+        sq = 0.0
+        for g in grads:
+            a = np.asarray(g).reshape(-1)
+            sq += float(np.vdot(a, a))
+        _metrics.gauge("trainer_grad_norm",
+                       "L2 norm of this step's pushed gradients").set(
+                           float(np.sqrt(sq)))
+
 
 # lazily-initialized sparse rows are uniform in this range (reference
 # lookup_sparse_table_op.cc min/max attrs default -1/1; embeddings converge
@@ -210,6 +233,8 @@ class AsyncPSTrainer:
         for (wname, uniq), g in zip(pushes,
                                     grads[len(self.t.param_specs):]):
             self.client.push_sparse_grad(wname, uniq, g[: uniq.shape[0]])
+        if _flags.get_flag("observe"):
+            _note_step_health(user_outs, grads[: len(self.t.param_specs)])
         return user_outs
 
     def save(self, dirname):
@@ -321,4 +346,6 @@ class SyncPSTrainer(AsyncPSTrainer):
         self.client.sync_apply(self.t._pserver_endpoints,
                                trainer_id=self.trainer_id)
         self._batch_id += 1
+        if _flags.get_flag("observe"):
+            _note_step_health(user_outs, grads)
         return user_outs

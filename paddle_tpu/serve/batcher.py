@@ -83,6 +83,11 @@ class MicroBatcher:
             "batches by bucket fit (exact = no row padding)")
         self._m_depth = _metrics.gauge(
             "serve_queue_depth", "requests waiting, per model")
+        # fluid-pulse: the saturation detector needs depth AND capacity
+        # from the registry to compute depth/capacity per model
+        self._m_qcap = _metrics.gauge(
+            "serve_queue_capacity", "admission-control bound, per model")
+        self._m_qcap.set(self._max_queue, model=name)
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name=f"serve-exec-{name}")
         self._thread.start()
@@ -371,6 +376,7 @@ class MicroBatcher:
                 self._timeout_s = max(batch_timeout_ms, 0.0) / 1e3
             if max_queue is not None:
                 self._max_queue = max_queue
+                self._m_qcap.set(max_queue, model=self._name)
             self._cond.notify_all()
 
     def close(self):
@@ -382,6 +388,10 @@ class MicroBatcher:
             dead = [r for q in self._queues.values() for r in q]
             self._queues.clear()
             self._pending = 0
+            # zero the depth gauge too: a frozen last-high value would
+            # keep the registry-driven saturation detector firing on a
+            # queue that no longer exists
+            self._m_depth.set(0, model=self._name)
             self._cond.notify_all()
         for r in dead:
             self._fail(r, ModelUnavailableError(

@@ -43,6 +43,10 @@ class ServeConfig:
     max_queue: int = 256              # admission-control bound, requests
     default_deadline_ms: Optional[float] = None
     watch_interval_s: float = 2.0
+    # fluid-pulse opt-in: expose this process's health plane and this
+    # server's queue-saturation readiness check on it (0 = ephemeral
+    # port; requires the observe flag — start_pulse refuses otherwise)
+    pulse_port: Optional[int] = None
 
 
 class InferenceServer:
@@ -53,6 +57,43 @@ class InferenceServer:
         self.registry = ModelRegistry(executor=self._exe)
         self._batchers: Dict[str, MicroBatcher] = {}
         self._closed = False
+        self.pulse_port: Optional[int] = None
+        self._pulse_check_name: Optional[str] = None
+        if self.config.pulse_port is not None:
+            from ..observe import health as _health
+            from ..observe import pulse as _pulse
+            self.pulse_port = _pulse.start_pulse(self.config.pulse_port)
+            # instance-scoped name: two servers in one process (blue/green
+            # swap, tests) must not clobber each other's check, and
+            # close() of one must not unregister the survivor's
+            self._pulse_check_name = f"serve_queues@{id(self):x}"
+            _health.get_engine().register_check(
+                self._pulse_check_name, self._pulse_queue_check,
+                ready=True)
+
+    def _pulse_queue_check(self):
+        """fluid-pulse /readyz check: per-model queue saturation — a
+        router should stop sending traffic here before requests start
+        bouncing off admission control. Shares the detector's threshold
+        (health.SERVE_QUEUE_SATURATION_FRAC) so the two verdicts in one
+        /healthz body can't diverge."""
+        from ..observe.health import SERVE_QUEUE_SATURATION_FRAC
+        detail, ok = {}, True
+        # snapshot: the ticker/scrape thread iterates while add_model may
+        # be inserting a batcher from another thread
+        for name, b in list(self._batchers.items()):
+            depth, cap = b.queue_depth(), max(b._max_queue, 1)
+            sat = depth / cap
+            detail[name] = {"depth": depth, "capacity": cap,
+                            "saturation": round(sat, 3),
+                            "version": None}
+            try:
+                detail[name]["version"] = self.registry.get(name).version_id
+            except Exception:
+                pass
+            if sat >= SERVE_QUEUE_SATURATION_FRAC:
+                ok = False
+        return ok, detail
 
     # -- model management ------------------------------------------------
 
@@ -158,6 +199,11 @@ class InferenceServer:
         if self._closed:
             return
         self._closed = True
+        if self._pulse_check_name is not None:
+            from ..observe import health as _health
+            _health.get_engine().unregister_check(self._pulse_check_name)
+            self._pulse_check_name = None
+            self.pulse_port = None
         for b in self._batchers.values():
             b.close()
         self._batchers.clear()

@@ -20,6 +20,7 @@ import numpy as np
 from . import ark as _ark
 from . import flags as _flags
 from . import io as fluid_io
+from .observe import health as _obs_health
 from .observe import metrics as _obs_metrics
 from .observe import tracer as _obs_tracer
 from . import unique_name
@@ -146,8 +147,16 @@ class Trainer:
 
     def __init__(self, train_func: Callable, optimizer_func: Callable,
                  param_path=None, place=None, parallel=False,
-                 checkpoint_config: Optional[CheckpointConfig] = None):
+                 checkpoint_config: Optional[CheckpointConfig] = None,
+                 pulse_port: Optional[int] = None):
         self.place = place or TPUPlace(0)
+        # fluid-pulse opt-in: expose this trainer process's live health
+        # plane (/metrics /healthz /status ...). Requires the observe
+        # flag — start_pulse refuses otherwise, by contract.
+        self.pulse_port = None
+        if pulse_port is not None:
+            from .observe import pulse as _obs_pulse
+            self.pulse_port = _obs_pulse.start_pulse(pulse_port)
         self.parallel = parallel
         self.checkpoint_cfg = checkpoint_config
         self.scope = Scope()
@@ -305,6 +314,11 @@ class Trainer:
                 event_handler(begin)
                 fetch = [self.loss] + self.metrics if begin.fetch_metrics else []
                 out = self._executor_run(feeder.feed(batch), fetch)
+                if out and _flags.get_flag("observe"):
+                    # fluid-pulse: the loss lands on the health plane's
+                    # time-series (non-finite detector food) via the
+                    # registry emit path the engine watches
+                    _obs_health.note_loss_fetch(out)
                 event_handler(EndStepEvent(epoch, step,
                                            [np.asarray(o) for o in out]))
                 step += 1

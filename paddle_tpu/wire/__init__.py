@@ -54,11 +54,32 @@ def wire_table(registry=None) -> List[str]:
     enc = registry.get(ENCODED_BYTES_METRIC)
     if raw is None or enc is None:
         return []
+    rows = []
+    for labels, r in sorted(raw.items(), key=lambda kv: str(kv[0])):
+        rows.append((labels.get("cmd", "?"), r, enc.value(**labels)))
+    return _wire_lines(rows)
+
+
+def wire_table_from_snapshot(snapshot) -> List[str]:
+    """Same table from a registry SNAPSHOT dict (fluid-pulse: what a
+    live `/status` scrape carries), so `tools/telemetry_dump.py --url`
+    prints the identical table for a remote process."""
+    raw = (snapshot.get(RAW_BYTES_METRIC) or {}).get("values") or {}
+    enc = (snapshot.get(ENCODED_BYTES_METRIC) or {}).get("values") or {}
+    if not raw or not enc:
+        return []
+    rows = []
+    for labelstr, r in sorted(raw.items()):
+        labels = dict(p.split("=", 1) for p in labelstr.split(",")
+                      if "=" in p)
+        rows.append((labels.get("cmd", "?"), r, enc.get(labelstr, 0.0)))
+    return _wire_lines(rows)
+
+
+def _wire_lines(rows) -> List[str]:
     lines = []
     total_raw = total_enc = 0.0
-    for labels, r in sorted(raw.items(), key=lambda kv: str(kv[0])):
-        cmd = labels.get("cmd", "?")
-        e = enc.value(**labels)
+    for cmd, r, e in rows:
         total_raw += r
         total_enc += e
         lines.append(f"  {cmd:<20} {r:>14,.0f} -> {e:>14,.0f} bytes  "

@@ -83,7 +83,12 @@ def _fresh_telemetry():
     recompilation observatory, flight recorder, and ambient trace
     context are shared process state — without this, tests could only
     assert snapshot-and-delta. The `observe` flag is restored too, so a
-    test that enables it cannot leak emission into its neighbors."""
+    test that enables it cannot leak emission into its neighbors.
+
+    fluid-pulse extension: reset_all() also STOPS any pulse HTTP server
+    the test started and clears the health engine + memory observatory,
+    so no pulse thread (or stale detector state) survives a test — the
+    teardown assertion below keeps that contract honest."""
     from paddle_tpu import flags, observe
 
     prev_observe = flags.get_flag("observe")
@@ -91,6 +96,10 @@ def _fresh_telemetry():
     if flags.get_flag("observe") != prev_observe:
         flags.set_flag("observe", prev_observe)
     observe.reset_all()
+    import threading
+    leaked = [t.name for t in threading.enumerate()
+              if t.name.startswith("pulse")]
+    assert not leaked, f"pulse thread(s) leaked across reset_all: {leaked}"
 
 
 @pytest.fixture(autouse=True)

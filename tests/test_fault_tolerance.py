@@ -663,3 +663,31 @@ def test_dist_trace_drill_merged_timeline_and_flight_dump(tmp_path):
     assert fr["process"] == "pserver0"
     assert str(fr["reason"]).startswith("signal")
     assert any(e["kind"] == "signal" for e in fr["events"])
+
+
+@pytest.mark.slow
+def test_health_alerts_drill(tmp_path):
+    """fluid-pulse CI gate: a live 2-process job with pulse armed on
+    both sides. The drill itself asserts the contract — /healthz flips
+    503/unready on a NaN loss, the pserver SIGKILL raises a retry-storm
+    alert, and the flight dump records both alerts with the triggering
+    series' last points — and exits nonzero on any miss."""
+    import json
+    import subprocess
+    import sys
+    wd = tmp_path / "health"
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "tools",
+                      "chaos_drill.py"),
+         "--scenario", "health_alerts", "--seed", "7",
+         "--workdir", str(wd)],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    # the drill's own flight artifact is readable standalone
+    with open(wd / "flight_trainer0.json") as f:
+        fr = json.load(f)
+    rules = {e.get("rule") for e in fr["events"]
+             if e.get("kind") == "alert"}
+    assert {"non_finite_loss", "ps_retry_storm"} <= rules

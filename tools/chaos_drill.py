@@ -31,6 +31,12 @@ Scenarios (all seed-deterministic through ark.chaos):
                   artifacts (chrome trace + flight-recorder JSON) and
                   the merged timeline links client and server RPC spans
                   under one trace id across the two processes
+    health_alerts a live 2-process job with fluid-pulse armed on both
+                  sides; a NaN loss and a pserver SIGKILL are injected;
+                  PASS = the trainer's /healthz flips to 503/unready
+                  with the expected alerts (non_finite_loss,
+                  ps_retry_storm) and the flight dump records both
+                  alerts with the triggering series' last points
 
 `--trace-out DIR` (any scenario): every participating process writes its
 chrome trace file into DIR (`trace_<process>.json`) and the drill merges
@@ -78,6 +84,13 @@ def _fresh_world(seed, n_servers=2, lr=0.1):
     servers = [ParameterServer("127.0.0.1:0").start()
                for _ in range(n_servers)]
     eps = ",".join(s.endpoint for s in servers)
+    tr, loss, batch = _build_world(eps, seed, lr=lr)
+    return servers, tr, loss, batch
+
+
+def _build_world(eps, seed, lr=0.1):
+    """Trainer half of the 2-layer FC world, against endpoints that may
+    live in ANOTHER process (the health_alerts drill's ps_worker)."""
     np.random.seed(seed)
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup), fluid.unique_name.guard():
@@ -104,7 +117,7 @@ def _fresh_world(seed, n_servers=2, lr=0.1):
         ys = (xs @ w_true).argmax(1).astype(np.int64).reshape(n, 1)
         return {"x": xs, "y": ys}
 
-    return servers, tr, loss, batch
+    return tr, loss, batch
 
 
 def _run_steps(tr, loss, batch, n):
@@ -402,6 +415,114 @@ def drill_dist_trace(seed, workdir, trace_out=None):
         fluid.set_flag("observe", False)
 
 
+def drill_health_alerts(seed, workdir, trace_out=None):
+    """fluid-pulse: a live 2-process job whose health plane must catch a
+    NaN loss and a pserver death WHILE RUNNING — before any postmortem.
+
+    A real trainer (this process, pulse armed) drives a real ps_worker
+    subprocess (pulse armed too). PASS requires: both /healthz
+    endpoints answer ok pre-fault; injecting a NaN batch flips the
+    trainer's /healthz to HTTP 503/unready with a `non_finite_loss`
+    alert; SIGKILLing the pserver raises a `ps_retry_storm` alert; and
+    the trainer's flight-recorder dump carries both alert records with
+    the last points of the triggering series — the endpoint and the
+    black box agree on why health went red."""
+    import json
+    import subprocess
+    import urllib.error
+    import urllib.request
+
+    from paddle_tpu.observe import flight, health, pulse
+
+    def get(port, path):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    fluid.set_flag("observe", True)
+    obs_metrics.default_registry().reset()
+    health.reset()
+    local_port = pulse.start_pulse(0)
+    print(f"  trainer pulse on port {local_port}")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "ps_worker.py")
+    proc = subprocess.Popen(
+        [sys.executable, worker, "--name", "pserver0", "--out", workdir,
+         "--pulse-port", "0"],
+        stdout=subprocess.PIPE, text=True, env=env)
+    tr = None
+    try:
+        line = (proc.stdout.readline() or "").strip()
+        _check(line.startswith("ENDPOINT "), f"server process up ({line})")
+        ep = line.split()[1]
+        line = (proc.stdout.readline() or "").strip()
+        _check(line.startswith("PULSE "), f"server pulse up ({line})")
+        srv_pulse = int(line.split()[1])
+        code, doc = get(srv_pulse, "/healthz")
+        _check(code == 200 and doc["status"] == "ok",
+               f"server /healthz ok pre-fault "
+               f"(checks: {sorted(doc['checks'])})")
+
+        tr, loss, batch = _build_world(ep, seed)
+        losses = _run_steps(tr, loss, batch, 8)
+        _check(np.isfinite(losses).all(), "8 healthy steps against the "
+               "remote pserver")
+        code, doc = get(local_port, "/healthz")
+        _check(code == 200 and doc["status"] == "ok",
+               "trainer /healthz ok pre-fault")
+
+        bad = batch()
+        bad["x"][:] = np.nan
+        tr.step(bad, fetch_list=[loss])
+        code, doc = get(local_port, "/healthz")
+        rules = {a["rule"] for a in doc["alerts"]}
+        _check(code == 503 and doc["status"] == "unready",
+               f"/healthz flipped unready on the NaN loss (HTTP {code})")
+        _check("non_finite_loss" in rules,
+               f"non-finite alert fired ({sorted(rules)})")
+
+        proc.kill()
+        proc.wait(timeout=30)
+        print("  SIGKILL'd the pserver process mid-run")
+        for _ in range(3):
+            try:
+                tr.step(batch(), fetch_list=[loss])
+            except Exception:
+                pass   # retries against the corpse are the point
+        code, doc = get(local_port, "/healthz")
+        rules = {a["rule"] for a in doc["alerts"]}
+        _check("ps_retry_storm" in rules,
+               f"retry-storm alert fired ({sorted(rules)})")
+        _check(code == 503, "trainer /healthz still unready")
+
+        fp = flight.dump(os.path.join(workdir, "flight_trainer0.json"),
+                         reason="health_alerts drill")
+        with open(fp) as f:
+            fr = json.load(f)
+        alert_evs = [e for e in fr["events"] if e.get("kind") == "alert"]
+        got = {e["rule"] for e in alert_evs}
+        _check({"non_finite_loss", "ps_retry_storm"} <= got,
+               f"flight ring recorded both alerts ({sorted(got)})")
+        _check(any(e.get("points") for e in alert_evs),
+               "alert records carry the triggering series' last points")
+        _check("memory" in fr, "flight dump carries the memory section")
+    finally:
+        if tr is not None:
+            try:
+                tr.close()
+            except Exception:
+                pass
+        if proc.poll() is None:
+            proc.kill()
+        pulse.stop_pulse()
+        health.reset()
+        fluid.set_flag("observe", False)
+
+
 SCENARIOS = {
     "flaky_rpc": drill_flaky_rpc,
     "quant_flaky_rpc": drill_quant_flaky_rpc,
@@ -409,6 +530,7 @@ SCENARIOS = {
     "ckpt_crash": drill_ckpt_crash,
     "sync_evict": drill_sync_evict,
     "dist_trace": drill_dist_trace,
+    "health_alerts": drill_health_alerts,
 }
 
 

@@ -36,6 +36,9 @@ def main(argv=None):
     ap.add_argument("--out", required=True,
                     help="dir for the trace + flight dumps")
     ap.add_argument("--trainers", type=int, default=1)
+    ap.add_argument("--pulse-port", type=int, default=None,
+                    help="start the fluid-pulse health endpoint on this "
+                         "port (0 = ephemeral); prints 'PULSE <port>'")
     args = ap.parse_args(argv)
 
     import jax
@@ -60,8 +63,11 @@ def main(argv=None):
                    extra=export_trace)
     flight.set_stage("serving")
 
-    srv = ParameterServer(args.endpoint, trainers=args.trainers).start()
+    srv = ParameterServer(args.endpoint, trainers=args.trainers,
+                          pulse_port=args.pulse_port).start()
     print(f"ENDPOINT {srv.endpoint}", flush=True)
+    if srv.pulse_port is not None:
+        print(f"PULSE {srv.pulse_port}", flush=True)
     threading.Event().wait()   # park; SIGTERM tears us down
 
 

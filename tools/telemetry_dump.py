@@ -30,6 +30,15 @@ whenever the run recorded pserver traffic); --trace PATH writes the
 unified chrome://tracing timeline (open in chrome://tracing or
 perfetto).
 
+Live-process mode (fluid-pulse):
+
+    python tools/telemetry_dump.py --url http://host:port [--format ...]
+
+reads a RUNNING process's pulse endpoint instead of running the local
+demo loop: `--format prom` prints its `/metrics` scrape verbatim,
+`json`/`table` render its `/status` document — the SAME shape the
+in-process path prints, so one tool reads dead and live processes.
+
 Multi-process stitch (fluid-xray):
 
     python tools/telemetry_dump.py --merge merged.json t0.json ps0.json
@@ -65,9 +74,60 @@ def build_model(fluid):
     return main, startup, loss
 
 
+def print_status_table(doc):
+    """Human summary of a status document — shared by the in-process
+    path and `--url` (identical output for identical telemetry)."""
+    from paddle_tpu.wire import wire_table_from_snapshot
+
+    steps = doc["steps"]
+    print(f"steps: {steps['steps']}  "
+          f"mean {steps['mean_step_us']:.1f} us/step")
+    for phase, us in sorted(steps["phase_us"].items(),
+                            key=lambda kv: -kv[1]):
+        print(f"  {phase:<16} {us:>12.1f} us total")
+    print("recompiles:", doc["recompiles"]["counts"] or "none")
+    mem = doc.get("memory") or {}
+    if mem.get("programs"):
+        print(f"memory: peak est {mem['estimate_peak_bytes'] / 1e6:.2f} MB "
+              f"over {len(mem['programs'])} program(s)"
+              + (f", live {mem['bytes_in_use'] / 1e6:.2f} MB in use"
+                 if mem.get("live") else " (estimate-only: no device "
+                 "memory stats on this backend)"))
+    alerts = doc.get("alerts") or []
+    if alerts:
+        print(f"ALERTS ({len(alerts)} active):")
+        for a in alerts:
+            print(f"  [{a['rule']}] {a['message']}")
+    else:
+        print("alerts: none")
+    for line in wire_table_from_snapshot(doc["metrics"]):
+        print(line)
+    print("metrics:", ", ".join(sorted(doc["metrics"])))
+
+
+def _fetch(url: str, timeout: float = 10.0):
+    """(status, body) — or (None, error string) when the process is
+    unreachable (dead, refused, timed out): the common case for a tool
+    that exists to read live processes must exit cleanly, not
+    traceback."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+    except (urllib.error.URLError, OSError) as e:
+        return None, str(e)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="dump fluid-scope telemetry of a short prepared run")
+    ap.add_argument("--url", metavar="http://host:port",
+                    help="read a LIVE process's pulse endpoint instead of "
+                         "running the local demo loop")
     ap.add_argument("--steps", type=int, default=3,
                     help="training steps to run (default 3)")
     ap.add_argument("--two-shapes", action="store_true",
@@ -105,6 +165,29 @@ def main(argv=None):
               file=sys.stderr)
         return 0
 
+    if args.url:
+        base = args.url.rstrip("/")
+        if args.format == "prom":
+            code, body = _fetch(f"{base}/metrics")
+            if code != 200:
+                print(f"GET {base}/metrics -> "
+                      f"{code if code is not None else body}",
+                      file=sys.stderr)
+                return 1
+            sys.stdout.write(body.decode())
+            return 0
+        code, body = _fetch(f"{base}/status")
+        if code != 200:
+            print(f"GET {base}/status -> "
+                  f"{code if code is not None else body}", file=sys.stderr)
+            return 1
+        doc = json.loads(body)
+        if args.format == "table":
+            print_status_table(doc)
+        else:
+            print(json.dumps(doc, indent=2, sort_keys=True, default=str))
+        return 0
+
     import jax
     jax.config.update("jax_platforms", "cpu")  # env var alone is overridden
 
@@ -133,23 +216,20 @@ def main(argv=None):
 
     if args.format == "prom":
         print(reg.to_prometheus())
-    elif args.format == "table":
-        summ = observe.summary()
-        print(f"steps: {summ['steps']['steps']}  "
-              f"mean {summ['steps']['mean_step_us']:.1f} us/step")
-        for phase, us in sorted(summ["steps"]["phase_us"].items(),
-                                key=lambda kv: -kv[1]):
-            print(f"  {phase:<16} {us:>12.1f} us total")
-        print("recompiles:", summ["recompiles"]["counts"] or "none")
-        # fluid-wire: raw vs on-wire bytes per pserver command, with the
-        # compression ratio — present whenever the run moved PS traffic
-        from paddle_tpu.wire import wire_table
-        for line in wire_table(reg):
-            print(line)
-        print("metrics:", ", ".join(reg.names()))
     else:
-        print(json.dumps(observe.summary(), indent=2, sort_keys=True,
-                         default=str))
+        # the in-process document is pulse.status_document(): identical
+        # in shape to a live /status scrape, so --url and the local demo
+        # render through the SAME printers. Built only on these branches
+        # — it evaluates detectors and probes device memory, side
+        # effects a prom scrape must not pay for. json_safe keeps the
+        # local json output strict-parseable (and byte-compatible with
+        # the --url path) when a metric or alert carries NaN/inf.
+        from paddle_tpu.observe.flight import json_safe
+        doc = json_safe(observe.pulse.status_document())
+        if args.format == "table":
+            print_status_table(doc)
+        else:
+            print(json.dumps(doc, indent=2, sort_keys=True, default=str))
 
     if args.trace:
         observe.get_tracer().export_chrome(args.trace)
