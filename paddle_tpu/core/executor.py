@@ -276,6 +276,29 @@ def _program_has_conv(program) -> bool:
     return hit[1]
 
 
+def donation_safe() -> bool:
+    """Whether donate_argnums may be used for compiled steps.
+
+    Buffer donation and the persistent compilation cache are MUTUALLY
+    EXCLUSIVE on this jaxlib's CPU backend: a warm-cache hit of a
+    donate_argnums executable loses its input-output aliasing on
+    deserialization and reuses the donated buffers while still
+    referenced — a use-after-free that bus-errors, segfaults, or
+    silently corrupts the carried state (minimal repro: a donated jit
+    run twice across processes against one cache dir; without donation
+    the same cache is bit-deterministic). Donated mutable state is a
+    core perf design (in-place HBM updates), so instead of banning the
+    cache, the executor drops donation whenever a compilation cache dir
+    is configured on a CPU backend — the cache is a test/dev iteration
+    lever (tests/conftest.py), never configured on the TPU
+    serving/training path, which keeps full donation."""
+    try:
+        cache_dir = jax.config.jax_compilation_cache_dir
+    except AttributeError:
+        return True
+    return not cache_dir or jax.default_backend() != "cpu"
+
+
 class _CompiledProgram:
     """One lowered+jitted step for a (program version, feed/fetch set)."""
 
@@ -368,7 +391,7 @@ class _CompiledProgram:
                      if lowerer.check_nan_inf else [])
             return fetches, new_state, flags
 
-        donate_args = (1,) if donate else ()
+        donate_args = (1,) if donate and donation_safe() else ()
         self._step = jax.jit(step, donate_argnums=donate_args,
                              compiler_options=compiler_options or None)
 

@@ -30,6 +30,11 @@ GRAD_SUFFIX = "@GRAD"
 # Companion variable carrying per-row sequence lengths for variable-length
 # (LoD-analog) tensors: padded dense data + `name@SEQLEN` int32[batch].
 SEQLEN_SUFFIX = "@SEQLEN"
+# fluid-decode: persistable-but-ephemeral device STATE (the paged KV
+# cache). Rides the scope like an optimizer accumulator but is never
+# serialized: io save/load predicates skip the suffix, and the serving
+# registry re-materializes zeros of the manifest-declared shape at load.
+KV_CACHE_SUFFIX = "@KV_CACHE"
 
 
 def grad_var_name(name: str) -> str:

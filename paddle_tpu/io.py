@@ -28,6 +28,9 @@ from .core.executor import Executor, Scope, global_scope
 logger = logging.getLogger(__name__)
 
 MODEL_FILENAME = "__model__"
+# fluid-decode: the autoregressive decode-step program of a generative
+# model rides next to the prefill `__model__` in the same atomic dir
+DECODE_FILENAME = "__decode__"
 PARAMS_SUFFIX = ".npy"
 # same name + schema as ark's checkpoint manifest, so
 # `ark.checkpoint.verify_checkpoint(model_dir)` works on a model dir too
@@ -43,7 +46,13 @@ class ModelIntegrityError(RuntimeError):
 
 
 def _is_persistable(var: ir.Variable) -> bool:
-    return var.persistable and not var.is_data and var.kind == ir.VarKind.DENSE_TENSOR
+    # KV-cache state is persistable ACROSS STEPS but not across saves:
+    # serializing gigabytes of transient cache (or trying to load it
+    # back) would be wrong both ways — the serving registry zeros it
+    # fresh from the manifest's decode signature at load
+    return var.persistable and not var.is_data \
+        and var.kind == ir.VarKind.DENSE_TENSOR \
+        and not var.name.endswith(ir.KV_CACHE_SUFFIX)
 
 
 def _is_parameter(var: ir.Variable) -> bool:
@@ -133,9 +142,19 @@ def load_persistables(executor, dirname, main_program=None, filename=None,
 
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
-                         params_filename=None, scope=None):
+                         params_filename=None, scope=None,
+                         extra_programs=None, manifest_extra=None):
     """Prune to the inference slice and persist program+params
     (reference io.py:551).
+
+    `extra_programs` ({filename: json-able meta dict}) lands additional
+    program files in the same atomic dir — fluid-decode ships the
+    decode-step program as `__decode__` next to the prefill `__model__`,
+    committed (and sha256-manifested) as one unit. `manifest_extra` is
+    merged into MANIFEST.json — the decode-step signature (max slots,
+    block size, max context, cache var names) lives there so a registry
+    load can size the KV cache and warm-compile the decode program
+    without a probe request; loaders of legacy manifests see neither key.
 
     ark crash safety: the whole model dir is STAGED in a same-parent tmp
     dir and swapped in at the end — program json and params commit as one
@@ -183,6 +202,9 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         with open(os.path.join(stage, model_filename or MODEL_FILENAME),
                   "w") as f:
             json.dump(meta, f)
+        for extra_name, extra_meta in (extra_programs or {}).items():
+            with open(os.path.join(stage, extra_name), "w") as f:
+                json.dump(extra_meta, f)
         save_persistables(executor, stage, pruned, params_filename, scope)
         # integrity manifest, written LAST inside the stage: a sha256 per
         # payload file, so load_inference_model (and ark's
@@ -197,8 +219,8 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         with atomic_file(os.path.join(stage, MODEL_MANIFEST), "w") as f:
             json.dump({"kind": "inference_model", "saved_at": time.time(),
                        "feed_names": list(feeded_var_names),
-                       "fetch_names": target_names, "files": files}, f,
-                      indent=1)
+                       "fetch_names": target_names, "files": files,
+                       **(manifest_extra or {})}, f, indent=1)
         if os.path.isdir(dirname):
             # swap: retire the old dir by rename (fast), bring the stage
             # in, then delete the retired copy. If the swap-in fails the
@@ -263,6 +285,22 @@ def load_inference_model(dirname, executor, model_filename=None,
     load_persistables(executor, dirname, program, params_filename, scope)
     fetch_vars = [program.global_block().var(n) for n in meta["fetch_names"]]
     return program, meta["feed_names"], fetch_vars
+
+
+def load_decode_program(dirname):
+    """Load a generative model dir's decode-step program (saved via
+    `extra_programs={DECODE_FILENAME: ...}`). Returns (program,
+    feed_names, fetch_names) or None when the dir has no decode step —
+    legacy one-shot model dirs load unchanged through
+    `load_inference_model` and never reach here."""
+    path = os.path.join(dirname, DECODE_FILENAME)
+    if not os.path.isfile(path):
+        return None
+    with open(path) as f:
+        meta = json.load(f)
+    program = ir.Program.from_dict(meta["program"])
+    program._is_inference = True
+    return program, list(meta["feed_names"]), list(meta["fetch_names"])
 
 
 def get_inference_program(target_vars, main_program=None):

@@ -11,3 +11,4 @@ from . import transformer  # noqa: F401
 from . import deepfm  # noqa: F401
 from . import machine_translation  # noqa: F401
 from . import se_resnext  # noqa: F401
+from . import tiny_lm  # noqa: F401

@@ -23,6 +23,9 @@ paper's per-task health story. Three pieces:
   * ``steady_state_recompile`` an unexpected observatory cause (not
                              warmup/first_call) after the grace steps
   * ``serve_queue_saturation`` queue depth >= 90% of capacity
+  * ``kv_cache_exhaustion``  paged KV blocks (allocated + reserved)
+                             >= 90% of capacity — generative admissions
+                             are about to start bouncing
   * ``serve_deadline_miss``  deadline rejections above a windowed rate
   * ``ps_retry_storm``       client RPC retries above a windowed rate
   * ``lease_churn``          evictions+readmissions above a windowed rate
@@ -377,35 +380,71 @@ class RecompileDetector(Detector):
 # two verdicts in one /healthz body can never use divergent thresholds
 SERVE_QUEUE_SATURATION_FRAC = 0.9
 
+# ONE definition of "nearly exhausted" shared by the detector and any
+# serving-side check, mirroring SERVE_QUEUE_SATURATION_FRAC's contract
+KV_CACHE_EXHAUSTION_FRAC = 0.9
 
-class QueueSaturationDetector(Detector):
-    """serve_queue_depth at or above `frac` of serve_queue_capacity for
-    any model label (both gauges are set by the MicroBatcher)."""
 
-    name = "serve_queue_saturation"
+class CapacityRatioDetector(Detector):
+    """Shared shape of every used-vs-capacity rule: a pair of gauges
+    with matching label sets; fire when ANY label's used >= frac *
+    capacity, clear when none is. `message_fmt` may reference {model},
+    {used}, {cap} and {frac}."""
+
     series = None
 
-    def __init__(self, frac: float = SERVE_QUEUE_SATURATION_FRAC):
+    def __init__(self, name: str, used_metric: str, capacity_metric: str,
+                 frac: float, message_fmt: str):
+        self.name = name
+        self.used_metric = used_metric
+        self.capacity_metric = capacity_metric
         self.frac = frac
+        self.message_fmt = message_fmt
 
     def check(self, engine, now):
         reg = _metrics.default_registry()
-        depth = reg.get("serve_queue_depth")
-        cap = reg.get("serve_queue_capacity")
-        if depth is None or cap is None:
+        used = reg.get(self.used_metric)
+        cap = reg.get(self.capacity_metric)
+        if used is None or cap is None:
             engine.clear(self)
             return
         caps = {tuple(sorted(labels.items())): v for labels, v in cap.items()}
-        for labels, d in depth.items():
+        for labels, u in used.items():
             c = caps.get(tuple(sorted(labels.items())))
-            if c and d >= self.frac * c:
-                engine.fire(self, observed=d, threshold=self.frac * c,
-                            message=f"serve queue "
-                                    f"{labels.get('model', '?')} at "
-                                    f"{d:.0f}/{c:.0f} "
-                                    f"(>= {self.frac:.0%})")
+            if c and u >= self.frac * c:
+                engine.fire(self, observed=u, threshold=self.frac * c,
+                            message=self.message_fmt.format(
+                                model=labels.get("model", "?"), used=u,
+                                cap=c, frac=self.frac))
                 return
         engine.clear(self)
+
+
+class QueueSaturationDetector(CapacityRatioDetector):
+    """serve_queue_depth at or above `frac` of serve_queue_capacity for
+    any model label (both gauges are set by the MicroBatcher)."""
+
+    def __init__(self, frac: float = SERVE_QUEUE_SATURATION_FRAC):
+        super().__init__(
+            "serve_queue_saturation", "serve_queue_depth",
+            "serve_queue_capacity", frac,
+            "serve queue {model} at {used:.0f}/{cap:.0f} (>= {frac:.0%})")
+
+
+class KvCacheExhaustionDetector(CapacityRatioDetector):
+    """fluid-decode: paged-KV occupancy (allocated + admission-reserved
+    blocks, i.e. exactly what the admission check sees) at or above
+    `frac` of capacity for any (model, version) label. Fires BEFORE
+    admissions start failing with CacheExhaustedError — the
+    router/operator signal to shed generative load or grow the cache.
+    Self-clears as finished sequences free their blocks."""
+
+    def __init__(self, frac: float = KV_CACHE_EXHAUSTION_FRAC):
+        super().__init__(
+            "kv_cache_exhaustion", "serve_kv_blocks_in_use",
+            "serve_kv_blocks_capacity", frac,
+            "KV cache {model} at {used:.0f}/{cap:.0f} blocks "
+            "(>= {frac:.0%}) — generative admissions about to stall")
 
 
 class CompressionCollapseDetector(Detector):
@@ -563,6 +602,7 @@ class HealthEngine:
                     RateCollapseDetector(),
                     RecompileDetector(),
                     QueueSaturationDetector(),
+                    KvCacheExhaustionDetector(),
                     RateSpikeDetector("ps_retry_storm", "ps_retries",
                                       window_s=15.0, threshold=8.0),
                     RateSpikeDetector("lease_churn", "lease_churn",

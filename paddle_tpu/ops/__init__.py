@@ -14,4 +14,5 @@ from . import quantize  # noqa: F401
 from . import beam  # noqa: F401
 from . import loss_extra  # noqa: F401
 from . import pallas_attention  # noqa: F401
+from . import paged_attention  # noqa: F401
 from . import extra_nn  # noqa: F401

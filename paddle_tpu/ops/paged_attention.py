@@ -1,0 +1,296 @@
+"""fluid-decode: ragged paged attention over a block-allocated KV cache.
+
+Autoregressive decode is memory-bound: every generated token re-reads the
+whole K/V history. Keeping that history contiguous per sequence would
+force either per-length compile signatures (a recompile per token) or a
+[slots, max_context] dense cache whose padding is re-read every step.
+The paged layout (Ragged Paged Attention, PAPERS.md) fixes both at once:
+
+- K/V live in fixed-size BLOCKS ``[num_blocks, block_size, heads, dh]``
+  owned by a persistent scope var, so every decode step has ONE static
+  shape signature and the compile cache stays warm forever;
+- each sequence owns an ordered list of block ids (its BLOCK TABLE, fed
+  as a ``[slots, max_blocks_per_seq]`` int32 array); attention gathers
+  K/V through the table and masks lanes at or past the sequence length,
+  so wildly ragged sequences share one step;
+- block 0 is a reserved TRASH block: inactive slots (and the padding
+  lanes of prefill writes) scatter there, keeping every scatter static —
+  no lane is ever conditionally skipped, just redirected somewhere no
+  read can see (reads mask by position, and position >= seq_len lanes
+  are masked regardless of which block the table names).
+
+Two phases share the cache:
+
+- ``prefill_attention``: the prompt runs ordinary causal (flash)
+  attention at its bucket-ladder rung, and its per-position K/V are
+  scattered into the sequence's blocks in the same jitted step;
+- ``paged_attention``: one new token per occupied slot — append its K/V
+  at position ``seq_len - 1``, attend over ``[0, seq_len)`` through the
+  block table.
+
+On TPU (or under PADDLE_TPU_PALLAS_INTERPRET=1) the decode read side
+runs as a Pallas kernel streaming cache blocks through the grid's
+innermost dimension with the block-table indirection in the index map
+(scalar prefetch); everywhere else a masked-lane jnp reference computes
+the same math — tests pin the reference path bit-identical to dense
+attention on the valid region, and the kernel against the reference
+under the interpreter.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from .pallas_attention import NEG_INF, flash_attention
+
+
+def _interpret():
+    return os.environ.get("PADDLE_TPU_PALLAS_INTERPRET", "0") == "1"
+
+
+def _pallas_ok():
+    return jax.default_backend() != "cpu" or _interpret()
+
+
+# ---------------------------------------------------------------------------
+# cache scatter (append / prefill write)
+# ---------------------------------------------------------------------------
+
+def kv_cache_append(k_cache, v_cache, k_new, v_new, block_tables, seq_lens):
+    """Write one new token's K/V per slot at position ``seq_len - 1``.
+
+    ``k_new``/``v_new``: [S, H, Dh]; caches [NB, BS, H, Dh]. Inactive
+    slots (seq_len == 0) write into the trash block 0 — the scatter stays
+    static and nothing ever reads block 0 unmasked."""
+    bs = k_cache.shape[1]
+    pos = jnp.maximum(seq_lens - 1, 0)
+    blk = jnp.take_along_axis(block_tables, (pos // bs)[:, None],
+                              axis=1)[:, 0]
+    active = seq_lens > 0
+    blk = jnp.where(active, blk, 0)
+    off = jnp.where(active, pos % bs, 0)
+    k_cache = k_cache.at[blk, off].set(k_new.astype(k_cache.dtype))
+    v_cache = v_cache.at[blk, off].set(v_new.astype(v_cache.dtype))
+    return k_cache, v_cache
+
+
+def kv_cache_prefill_write(k_cache, v_cache, k, v, block_tables, seq_lens):
+    """Scatter a padded prompt's K/V ([B, T, H, Dh]) into each row's
+    blocks; positions at or past the row's seq_len land in trash block 0."""
+    bs = k_cache.shape[1]
+    B, T = k.shape[0], k.shape[1]
+    t = jnp.arange(T)
+    blk = jnp.take_along_axis(
+        block_tables, jnp.broadcast_to((t // bs)[None, :], (B, T)), axis=1)
+    valid = t[None, :] < seq_lens[:, None]
+    blk = jnp.where(valid, blk, 0)
+    off = jnp.broadcast_to((t % bs)[None, :], (B, T))
+    flat_blk = blk.reshape(-1)
+    flat_off = off.reshape(-1)
+    k_cache = k_cache.at[flat_blk, flat_off].set(
+        k.reshape((B * T,) + k.shape[2:]).astype(k_cache.dtype))
+    v_cache = v_cache.at[flat_blk, flat_off].set(
+        v.reshape((B * T,) + v.shape[2:]).astype(v_cache.dtype))
+    return k_cache, v_cache
+
+
+# ---------------------------------------------------------------------------
+# masked-lane reference math (CPU path; the numerical contract)
+# ---------------------------------------------------------------------------
+
+def paged_attention_reference(q, k_cache, v_cache, block_tables, seq_lens,
+                              sm_scale):
+    """q: [S, H, Dh] (one token per slot). Gathers each slot's K/V
+    through its block table into a dense [S, T, H, Dh] view (T =
+    max_blocks_per_seq * block_size), masks lanes >= seq_len, and runs
+    one softmax(QK^T)V. Inactive slots return zeros."""
+    S, H, Dh = q.shape
+    nb, bs = k_cache.shape[0], k_cache.shape[1]
+    T = block_tables.shape[1] * bs
+    flat = (block_tables[:, :, None] * bs
+            + jnp.arange(bs)[None, None, :]).reshape(S, T)
+    k = jnp.take(k_cache.reshape(nb * bs, H, Dh), flat, axis=0)
+    v = jnp.take(v_cache.reshape(nb * bs, H, Dh), flat, axis=0)
+    s = jnp.einsum("shd,sthd->sht", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    mask = jnp.arange(T)[None, :] < seq_lens[:, None]
+    s = jnp.where(mask[:, None, :], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("sht,sthd->shd", p, v.astype(jnp.float32)) \
+        / jnp.maximum(l, 1e-20)[..., 0][..., None]
+    o = jnp.where((seq_lens > 0)[:, None, None], o, 0.0)
+    return o.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas kernel: stream cache blocks via block-table indirection
+# ---------------------------------------------------------------------------
+
+def _paged_decode_kernel(seq_lens_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_sc, l_sc, acc_sc, *, sm_scale, block_size):
+    """Grid (slot, block-ordinal). The k/v BlockSpec index maps read the
+    prefetched block table, so program (s, j) sees the j-th cache block
+    of slot s — the paged gather costs a scalar lookup, not a host-side
+    reorder. Online-softmax state is carried in VMEM scratch across the
+    innermost (sequential) dimension, exactly the flash-attention idiom
+    of ops/pallas_attention.py."""
+    from jax.experimental import pallas as pl
+
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    seq_len = seq_lens_ref[s]
+    # blocks wholly past the sequence contribute nothing; an inactive
+    # slot (seq_len 0) never updates, leaving acc at zeros
+    live = j * block_size < seq_len
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0]                                    # [H, Dh]
+        k = k_ref[0]                                    # [BS, H, Dh]
+        v = v_ref[0]
+        scores = jnp.einsum(
+            "hd,bhd->hb", q.astype(jnp.float32),
+            k.astype(jnp.float32),
+            preferred_element_type=jnp.float32) * sm_scale  # [H, BS]
+        pos = j * block_size + lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        scores = jnp.where(pos < seq_len, scores, NEG_INF)
+        m = m_sc[...]
+        m_new = jnp.maximum(m, jnp.max(scores, axis=1))
+        p = jnp.exp(scores - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_sc[...] = l_sc[...] * alpha + jnp.sum(p, axis=1)
+        acc_sc[...] = acc_sc[...] * alpha[:, None] + jnp.einsum(
+            "hb,bhd->hd", p, v.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        m_sc[...] = m_new
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        l = jnp.maximum(l_sc[...], 1e-20)
+        o_ref[0] = (acc_sc[...] / l[:, None]).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(q, k_cache, v_cache, block_tables, seq_lens,
+                            sm_scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S, H, Dh = q.shape
+    bs = k_cache.shape[1]
+    max_b = block_tables.shape[1]
+    kernel = functools.partial(_paged_decode_kernel, sm_scale=sm_scale,
+                               block_size=bs)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, max_b),
+        in_specs=[
+            pl.BlockSpec((1, H, Dh), lambda s, j, sl, bt: (s, 0, 0)),
+            pl.BlockSpec((1, bs, H, Dh),
+                         lambda s, j, sl, bt: (bt[s, j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, H, Dh),
+                         lambda s, j, sl, bt: (bt[s, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, Dh), lambda s, j, sl, bt: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H,), jnp.float32),
+            pltpu.VMEM((H,), jnp.float32),
+            pltpu.VMEM((H, Dh), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, Dh), q.dtype),
+        interpret=_interpret(),
+    )(seq_lens.astype(jnp.int32), block_tables.astype(jnp.int32),
+      q, k_cache, v_cache)
+
+
+def paged_attention(q, k_cache, v_cache, block_tables, seq_lens,
+                    sm_scale=None):
+    """Public entry: kernel on TPU / under the interpreter, masked-lane
+    reference math elsewhere (the CPU test suite pins the reference
+    bit-identical to dense attention on the valid region)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if _pallas_ok():
+        return _paged_attention_pallas(q, k_cache, v_cache, block_tables,
+                                       seq_lens, sm_scale)
+    return paged_attention_reference(q, k_cache, v_cache, block_tables,
+                                     seq_lens, sm_scale)
+
+
+# ---------------------------------------------------------------------------
+# registered ops (the decode/prefill program building blocks)
+# ---------------------------------------------------------------------------
+
+@register_op("paged_attention", propagate_seqlen=False)
+def _paged_attention_op(ctx, Q, K, V, KCache, VCache, BlockTables, SeqLens):
+    """One decode step. Q/K/V: [slots, d_model] — this step's token per
+    slot. Appends K/V at position seq_len-1 (in place: KCacheOut/VCacheOut
+    alias the cache vars, so the executor donates the HBM buffers), then
+    attends over [0, seq_len) through the block table. attrs: num_heads,
+    sm_scale."""
+    H = int(ctx.attr("num_heads", 1))
+    S, D = Q.shape
+    Dh = D // H
+    sm_scale = float(ctx.attr("sm_scale", 1.0 / math.sqrt(Dh)))
+    seq = SeqLens.astype(jnp.int32)
+    bt = BlockTables.astype(jnp.int32)
+    kc, vc = kv_cache_append(KCache, VCache, K.reshape(S, H, Dh),
+                             V.reshape(S, H, Dh), bt, seq)
+    out = paged_attention(Q.reshape(S, H, Dh), kc, vc, bt, seq, sm_scale)
+    return {"Out": out.reshape(S, D), "KCacheOut": kc, "VCacheOut": vc}
+
+
+@register_op("prefill_attention", propagate_seqlen=False)
+def _prefill_attention_op(ctx, Q, K, V, KCache, VCache, BlockTables,
+                          SeqLens):
+    """Prompt phase. Q/K/V: [rows, T, d_model] at a bucket-ladder rung.
+    Runs causal attention over the padded prompt (right-padding is
+    invisible to valid positions under the causal mask) and scatters each
+    row's K/V into its blocks in the same step. attrs: num_heads,
+    sm_scale."""
+    H = int(ctx.attr("num_heads", 1))
+    B, T, D = Q.shape
+    Dh = D // H
+    sm_scale = float(ctx.attr("sm_scale", 1.0 / math.sqrt(Dh)))
+    seq = SeqLens.astype(jnp.int32)
+    bt = BlockTables.astype(jnp.int32)
+    k4 = K.reshape(B, T, H, Dh)
+    v4 = V.reshape(B, T, H, Dh)
+    out = flash_attention(
+        Q.reshape(B, T, H, Dh).transpose(0, 2, 1, 3),
+        k4.transpose(0, 2, 1, 3), v4.transpose(0, 2, 1, 3),
+        jnp.int32(0), True, sm_scale, 0.0)
+    kc, vc = kv_cache_prefill_write(KCache, VCache, k4, v4, bt, seq)
+    return {"Out": out.transpose(0, 2, 1, 3).reshape(B, T, D),
+            "KCacheOut": kc, "VCacheOut": vc}
+
+
+@register_op("gather_last_token", propagate_seqlen=False)
+def _gather_last_token(ctx, X, SeqLens):
+    """X: [rows, T, D] -> Out: [rows, D], each row's position
+    seq_len - 1 (clamped into range; rows with seq_len 0 read position 0
+    — callers never use their output)."""
+    idx = jnp.clip(SeqLens.astype(jnp.int32) - 1, 0, X.shape[1] - 1)
+    return {"Out": jnp.take_along_axis(
+        X, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]}

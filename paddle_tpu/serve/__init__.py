@@ -24,11 +24,15 @@ each independently testable:
 
 from __future__ import annotations
 
-from .batcher import MicroBatcher  # noqa: F401
+from .batcher import MicroBatcher, SlotScheduler  # noqa: F401
 from .bucketing import (DEFAULT_ROWS_LADDER, BucketLadder,  # noqa: F401
                         plan_request, warm_feed_shapes)
-from .errors import (BadRequestError, DeadlineExceededError,  # noqa: F401
-                     ModelNotFoundError, ModelUnavailableError,
-                     QueueFullError, ServeError)
-from .registry import ModelRegistry, ModelVersion  # noqa: F401
+from .decode import (DecodeEngine, GenerationResult,  # noqa: F401
+                     GenerationStream)
+from .errors import (BadRequestError, CacheExhaustedError,  # noqa: F401
+                     DeadlineExceededError, ModelNotFoundError,
+                     ModelUnavailableError, QueueFullError, ServeError)
+from .kvcache import PagedKVCache  # noqa: F401
+from .registry import (DecodeModel, ModelRegistry,  # noqa: F401
+                       ModelVersion, read_decode_signature)
 from .server import InferenceServer, ServeConfig  # noqa: F401

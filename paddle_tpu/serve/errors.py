@@ -49,3 +49,13 @@ class DeadlineExceededError(ServeError):
     was dropped without executing."""
 
     retriable = True
+
+
+class CacheExhaustedError(ServeError):
+    """fluid-decode admission control: the paged KV cache cannot reserve
+    enough blocks to guarantee the generation completes. The request was
+    NOT admitted; blocks free as running sequences finish — retry with
+    backoff (the `kv_cache_exhaustion` health detector fires before this
+    starts happening)."""
+
+    retriable = True

@@ -28,11 +28,14 @@ dir costs an error log, not an outage.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import threading
 import time
 from typing import Dict, List, Optional
+
+import numpy as np
 
 from .. import io as _io
 from ..core.executor import CPUPlace, Executor, Place, Scope
@@ -40,6 +43,7 @@ from ..observe import metrics as _metrics
 from ..observe import steplog as _steplog
 from .bucketing import BucketLadder, feed_spec, warm_feed_shapes
 from .errors import ModelNotFoundError, ModelUnavailableError
+from .kvcache import PagedKVCache
 
 logger = logging.getLogger(__name__)
 
@@ -50,6 +54,43 @@ def _fingerprint(dirname: str):
     mtime); stat of the dir itself is race-free against the swap."""
     st = os.stat(dirname)
     return (st.st_ino, st.st_mtime_ns)
+
+
+class DecodeModel:
+    """fluid-decode sidecar of a generative ModelVersion: the decode-step
+    program prepared against the SAME scope as the prefill program (they
+    share parameters and the ``*@KV_CACHE`` cache vars), plus the host
+    block allocator. Built entirely from the MANIFEST's decode signature
+    — no probe request needed to warm-compile."""
+
+    def __init__(self, program, prepared, feed_names, fetch_names,
+                 signature: dict, kvcache: PagedKVCache):
+        self.program = program
+        self.prepared = prepared
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        self.signature = dict(signature)
+        self.kvcache = kvcache
+
+
+def read_decode_signature(dirname: str) -> Optional[dict]:
+    """The MANIFEST's `decode` key, or None for one-shot (legacy) model
+    dirs — those load exactly as before."""
+    path = os.path.join(dirname, _io.MODEL_MANIFEST)
+    if not os.path.isfile(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f).get("decode")
+    except (OSError, json.JSONDecodeError):
+        return None   # verify=True inside the load will name the problem
+
+
+def ladder_from_signature(sig: dict) -> BucketLadder:
+    """The prefill bucket ladder a decode signature implies: prompt rows
+    x prompt-length rungs (block_tables/seq_lens ride the rows dim)."""
+    return BucketLadder(rows=tuple(sig["prefill_rows"]),
+                        dims={"tokens": {1: tuple(sig["prefill_seq_rungs"])}})
 
 
 class ModelVersion:
@@ -69,9 +110,14 @@ class ModelVersion:
         self.ladder = ladder
         self.spec = spec
         self.loaded_at = time.time()
+        self.decode: Optional[DecodeModel] = None
         self._refs = 0
         self._retired = False
         self._fully_retired = threading.Event()
+
+    @property
+    def generative(self) -> bool:
+        return self.decode is not None
 
     @property
     def version_id(self) -> str:
@@ -113,6 +159,16 @@ class ModelRegistry:
         `dirname`. Blocks until the new version is verified, loaded and
         warmed; only then does the published pointer flip."""
         dirname = os.path.abspath(dirname)
+        # ONE manifest read per load: the ladder below and the cache
+        # sizing in _load_version must come from the same signature (two
+        # reads would race a concurrent atomic dir swap into a version
+        # whose ladder disagrees with its warmed buckets)
+        sig = read_decode_signature(dirname)
+        if ladder is None and sig is not None:
+            # generative dir + no explicit ladder: the MANIFEST's decode
+            # signature names the prefill rows/length rungs — a registry
+            # load warm-compiles both programs with no probe request
+            ladder = ladder_from_signature(sig)
         with self._lock:
             slot = self._slots.get(name)
             if slot is None:
@@ -122,13 +178,13 @@ class ModelRegistry:
                 slot.dirname = dirname
                 if ladder is not None:
                     slot.ladder = ladder
-        ver = self._load_version(name, dirname, slot.ladder, warm)
+        ver = self._load_version(name, dirname, slot.ladder, warm, sig)
         with self._lock:
             old, slot.current = slot.current, ver
             if old is not None:
                 old._retired = True
                 if old._refs == 0:
-                    old._fully_retired.set()
+                    self._fully_retire_locked(old)
         if old is not None:
             _metrics.counter(
                 "serve_hot_swaps_total",
@@ -138,7 +194,8 @@ class ModelRegistry:
                         old._refs)
         return ver
 
-    def _load_version(self, name, dirname, ladder, warm) -> ModelVersion:
+    def _load_version(self, name, dirname, ladder, warm,
+                      sig=None) -> ModelVersion:
         t0 = time.perf_counter()
         fp = _fingerprint(dirname)
         scope = Scope()
@@ -148,14 +205,26 @@ class ModelRegistry:
         program, feed_names, fetch_vars = _io.load_inference_model(
             dirname, self._exe, scope=scope, verify=True)
         spec = feed_spec(program, feed_names)
+        if sig is not None:
+            # KV cache state is never serialized (io._is_persistable
+            # skips the @KV_CACHE suffix): materialize zeros of the
+            # manifest-declared shape BEFORE anything compiles
+            shape = (sig["num_blocks"], sig["block_size"],
+                     sig["num_heads"], sig["head_dim"])
+            for cname in sig["cache_vars"]:
+                scope.set_var(cname, np.zeros(shape, np.float32))
         prepared = self._exe.prepare(program, fetch_list=fetch_vars,
                                      scope=scope)
         prepared.telemetry_source = "serving"
         ver = ModelVersion(name, dirname, fp, program, list(feed_names),
                            [v.name for v in fetch_vars], scope, prepared,
                            ladder, spec)
+        if sig is not None:
+            ver.decode = self._load_decode(ver, sig)
         if warm:
             self._warm(ver)
+            if ver.decode is not None:
+                self._warm_decode(ver)
         _metrics.counter("serve_model_loads_total",
                          "model versions loaded (incl. warmup)").inc(
                              model=name)
@@ -164,6 +233,40 @@ class ModelRegistry:
             "load+verify+warm wall time per version").observe(
                 time.perf_counter() - t0, model=name)
         return ver
+
+    def _load_decode(self, ver: ModelVersion, sig) -> DecodeModel:
+        """Prepare the decode-step program against the version's scope
+        (shared params + cache vars) and build its block allocator."""
+        loaded = _io.load_decode_program(ver.dirname)
+        if loaded is None:
+            raise ModelUnavailableError(
+                f"model dir {ver.dirname} declares a decode signature in "
+                f"its manifest but has no {_io.DECODE_FILENAME} program")
+        dprog, dfeeds, dfetches = loaded
+        fetch_vars = [dprog.global_block().var(n) for n in dfetches]
+        prepared = self._exe.prepare(dprog, fetch_list=fetch_vars,
+                                     scope=ver.scope)
+        prepared.telemetry_source = "serving"
+        kv = PagedKVCache(sig["num_blocks"], sig["block_size"],
+                          sig["max_blocks_per_seq"], sig["max_slots"],
+                          model=ver.name, version=ver.version_id)
+        return DecodeModel(dprog, prepared, dfeeds, dfetches, sig, kv)
+
+    def _warm_decode(self, ver: ModelVersion):
+        """Compile the decode step ahead of traffic. The step has exactly
+        ONE feed signature (fixed slots, fixed block-table width), so one
+        zero-feed run covers every future step — steady-state decode can
+        never miss the compile cache."""
+        dec = ver.decode
+        S = dec.signature["max_slots"]
+        feeds = {
+            "tokens": np.zeros((S, 1), np.int64),
+            "block_tables": np.zeros(
+                (S, dec.signature["max_blocks_per_seq"]), np.int32),
+            "seq_lens": np.zeros((S,), np.int32),
+        }
+        dec.prepared.run(feeds)
+        _steplog.preseed_shapes(dec.prepared._entry, feeds)
 
     def _warm(self, ver: ModelVersion):
         """Compile every ladder bucket ahead of traffic. The first run
@@ -237,11 +340,20 @@ class ModelRegistry:
             ver._refs += 1
         return ver
 
+    @staticmethod
+    def _fully_retire_locked(ver: ModelVersion):
+        """Unpublished AND drained: release observability state too — a
+        retired generative version's frozen KV gauges would otherwise
+        keep (or mask) the kv_cache_exhaustion verdict forever."""
+        ver._fully_retired.set()
+        if ver.decode is not None:
+            ver.decode.kvcache.close()
+
     def release(self, ver: ModelVersion):
         with self._lock:
             ver._refs -= 1
             if ver._retired and ver._refs == 0:
-                ver._fully_retired.set()
+                self._fully_retire_locked(ver)
 
     def names(self) -> List[str]:
         with self._lock:
@@ -287,6 +399,10 @@ class ModelRegistry:
                 if slot.current is not None:
                     slot.current._retired = True
                     if slot.current._refs == 0:
-                        slot.current._fully_retired.set()
+                        self._fully_retire_locked(slot.current)
+                    elif slot.current.decode is not None:
+                        # shutting down with refs still held: zero the
+                        # gauges anyway — no more traffic is coming
+                        slot.current.decode.kvcache.close()
                 slot.current = None
             self._slots.clear()

@@ -31,7 +31,9 @@ from ..core.executor import Executor, Place
 from ..observe import metrics as _metrics
 from .batcher import MicroBatcher
 from .bucketing import BucketLadder
-from .errors import DeadlineExceededError, ModelNotFoundError
+from .decode import DecodeEngine, GenerationResult, GenerationStream
+from .errors import (BadRequestError, DeadlineExceededError,
+                     ModelNotFoundError)
 from .registry import ModelRegistry
 
 
@@ -43,6 +45,10 @@ class ServeConfig:
     max_queue: int = 256              # admission-control bound, requests
     default_deadline_ms: Optional[float] = None
     watch_interval_s: float = 2.0
+    # fluid-decode: slot-admission policy for generative models —
+    # "continuous" (finished sequences vacate mid-batch, default) or
+    # "drain" (classic drain-and-refill; the bench A/B baseline)
+    decode_admission: str = "continuous"
     # fluid-pulse opt-in: expose this process's health plane and this
     # server's queue-saturation readiness check on it (0 = ephemeral
     # port; requires the observe flag — start_pulse refuses otherwise)
@@ -56,6 +62,7 @@ class InferenceServer:
         self._exe = Executor(place) if place is not None else Executor()
         self.registry = ModelRegistry(executor=self._exe)
         self._batchers: Dict[str, MicroBatcher] = {}
+        self._engines: Dict[str, DecodeEngine] = {}
         self._closed = False
         self.pulse_port: Optional[int] = None
         self._pulse_check_name: Optional[str] = None
@@ -104,8 +111,26 @@ class InferenceServer:
         """Load, verify, warm and publish a model, then start its
         executor thread. Calling again with the same name hot-swaps (and
         applies any explicitly passed batcher settings to the live
-        batcher)."""
-        self.registry.load(name, dirname, ladder=ladder, warm=warm)
+        batcher). A generative dir (decode signature in its MANIFEST)
+        gets a DecodeEngine — generate/submit_stream — instead of a
+        one-shot MicroBatcher."""
+        ver = self.registry.load(name, dirname, ladder=ladder, warm=warm)
+        # a re-register may change the model's KIND (one-shot <->
+        # generative): the stale request path must go, or infer() would
+        # keep routing one-shot feeds at a prefill program (and
+        # generate() would never find its engine)
+        if ver.generative and name in self._batchers:
+            self._batchers.pop(name).close()
+        if not ver.generative and name in self._engines:
+            self._engines.pop(name).close()
+        if ver.generative:
+            if name not in self._engines:
+                self._engines[name] = DecodeEngine(
+                    self.registry, name,
+                    max_queue=(max_queue if max_queue is not None
+                               else self.config.max_queue),
+                    admission=self.config.decode_admission)
+            return ver
         if name not in self._batchers:
             self._batchers[name] = MicroBatcher(
                 self.registry, name,
@@ -133,12 +158,64 @@ class InferenceServer:
                deadline_ms: Optional[float] = None) -> Future:
         batcher = self._batchers.get(name)
         if batcher is None:
+            if name in self._engines:
+                raise BadRequestError(
+                    f"model {name!r} is a generative model — use "
+                    f"generate/submit_generate/submit_stream, not "
+                    f"infer/submit")
             raise ModelNotFoundError(
                 f"no model registered as {name!r} "
                 f"(registered: {sorted(self._batchers)})")
         if deadline_ms is None:
             deadline_ms = self.config.default_deadline_ms
         return batcher.submit(feed, deadline_ms=deadline_ms)
+
+    # -- generative request path (fluid-decode) ---------------------------
+
+    def _engine(self, name: str) -> DecodeEngine:
+        eng = self._engines.get(name)
+        if eng is None:
+            if name in self._batchers:
+                raise BadRequestError(
+                    f"model {name!r} is a one-shot inference model — use "
+                    f"infer/submit, not generate")
+            raise ModelNotFoundError(
+                f"no generative model registered as {name!r} "
+                f"(registered: {sorted(self._engines)})")
+        return eng
+
+    def generate(self, name: str, prompt,
+                 max_new_tokens: int = 16,
+                 deadline_ms: Optional[float] = None) -> GenerationResult:
+        """Blocking autoregressive generation (greedy). Returns a
+        GenerationResult; retriable backpressure raises QueueFullError /
+        CacheExhaustedError immediately."""
+        if deadline_ms is None:
+            deadline_ms = self.config.default_deadline_ms
+        return self._engine(name).generate(
+            prompt, max_new_tokens=max_new_tokens, deadline_ms=deadline_ms)
+
+    def submit_generate(self, name: str, prompt,
+                        max_new_tokens: int = 16,
+                        deadline_ms: Optional[float] = None) -> Future:
+        """Non-blocking generation: returns the Future of its
+        GenerationResult."""
+        if deadline_ms is None:
+            deadline_ms = self.config.default_deadline_ms
+        return self._engine(name).submit(
+            prompt, max_new_tokens=max_new_tokens, deadline_ms=deadline_ms)
+
+    def submit_stream(self, name: str, prompt,
+                      max_new_tokens: int = 16,
+                      deadline_ms: Optional[float] = None
+                      ) -> GenerationStream:
+        """Streaming generation: iterate the returned stream for tokens
+        as they decode; stream.future resolves to the GenerationResult."""
+        if deadline_ms is None:
+            deadline_ms = self.config.default_deadline_ms
+        return self._engine(name).submit(
+            prompt, max_new_tokens=max_new_tokens, deadline_ms=deadline_ms,
+            stream=True)
 
     def infer(self, name: str, feed: Dict[str, np.ndarray],
               deadline_ms: Optional[float] = None) -> List[np.ndarray]:
@@ -193,6 +270,16 @@ class InferenceServer:
                     for outcome in ("ok", "error", "deadline", "queue_full")
                 },
             }
+        for name, eng in self._engines.items():
+            ver = None
+            try:
+                ver = self.registry.get(name)
+            except Exception:
+                pass
+            entry = {"version": ver.version_id if ver else None,
+                     "generative": True}
+            entry.update(eng.stats())
+            out["models"][name] = entry
         return out
 
     def close(self):
@@ -207,6 +294,9 @@ class InferenceServer:
         for b in self._batchers.values():
             b.close()
         self._batchers.clear()
+        for e in self._engines.values():
+            e.close()
+        self._engines.clear()
         self.registry.close()
 
     def __enter__(self):

@@ -29,7 +29,18 @@ if not _ON_TPU:
     # jax_platforms; tests must run on the virtual 8-device CPU mesh.
     jax.config.update("jax_platforms", "cpu")
     # persistent compile cache: repeat suite runs skip recompilation of
-    # unchanged programs entirely (iteration-speed lever on the 1-core box)
+    # unchanged programs entirely (iteration-speed lever on the 1-core
+    # box — without it the suite blows the tier-1 time budget).
+    # SOUNDNESS: on this jaxlib a warm-cache hit of a donate_argnums
+    # executable is a use-after-free on the CPU backend (deserialized
+    # executables lose their input-output aliasing), which made every
+    # warm-process stateful step silently corruptible — the root cause
+    # of the former "~1-in-6" flake of test_wire.py::test_comm_quant_
+    # parallel_executor_zero_recompiles_and_band and of sporadic
+    # teardown faulthandler dumps. The executor now DROPS donation
+    # whenever a cache dir is configured on a CPU backend
+    # (core/executor.py::donation_safe), so enabling the cache here is
+    # safe by construction.
     _cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               ".jax_compile_cache")
     jax.config.update("jax_compilation_cache_dir", _cache_dir)

@@ -27,6 +27,9 @@ def test_dryrun_16_devices_dp4_mp2_sp2():
     env["JAX_PLATFORMS"] = "cpu"
     # reuse the suite's persistent compile cache so the repeat cost is
     # near-zero once the 16-way step has been compiled on this machine
+    # (safe: with a cache dir configured on CPU the executor drops
+    # buffer donation — core/executor.py::donation_safe — so warm-cache
+    # hits cannot use-after-free the donated state)
     env.setdefault("JAX_COMPILATION_CACHE_DIR",
                    os.path.join(REPO, "tests", ".jax_compile_cache"))
     out = subprocess.run(

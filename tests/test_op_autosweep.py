@@ -576,6 +576,9 @@ WAIVED = {
     "beam_backtrack": "beam state machine; tests/test_machine_translation.py",
     "tile_beam": "beam plumbing; tests/test_machine_translation.py",
     "fused_attention": "pallas kernel; tests/test_flash_attention.py",
+    "paged_attention": "stateful KV-cache step; tests/test_decode.py",
+    "prefill_attention": "stateful KV-cache step; tests/test_decode.py",
+    "gather_last_token": "index gather, inference-only; tests/test_decode.py",
     "auc": "stateful metric accumulators; tests/test_smoke.py metrics",
     "sequence_slice": "padded-slice vs numpy; tests/test_api_breadth.py",
     "sequence_erase": "stable-sort compaction; tests/test_api_breadth.py",

@@ -13,12 +13,24 @@ reports the serving numbers bench.py records:
         atomically saved over the model dir and the registry watcher
         hot-swaps it mid-traffic.
 
+    python tools/serve_loadgen.py --workload generate --duration 10
+        fluid-decode drill: open-loop GENERATIVE traffic (tiny LM,
+        ragged prompt/output lengths) through the paged-KV continuous-
+        batching engine, with the same mid-run hot-swap drill. A fixed
+        probe set is decoded SOLO first; probe prompts re-issued under
+        load must produce token-identical generations (greedy decode is
+        deterministic — any divergence is a KV-cache aliasing or
+        batching bug). `--admission drain` runs the drain-and-refill
+        baseline the bench A/Bs against.
+
 Exit status is the CI gate: nonzero if ANY steady-state recompile was
 recorded by the observatory after warmup (cause `padding_bucket` means
 the bucket ladder is mis-sized; `feed_shape`/anything else means a cache
-bug), if any request failed, or if the hot swap didn't land. The JSON
-line on stdout carries serve_p50_us / serve_p99_us / serve_qps /
-serve_recompiles plus occupancy and padding-waste detail.
+bug), if any request failed, if the hot swap didn't land — and, for
+generate, if any under-load generation mismatched its solo reference.
+The JSON line on stdout carries serve_p50_us / serve_p99_us / serve_qps
+/ serve_recompiles (one-shot) or decode_tokens_per_s / ttft_p50_us /
+ttft_p99_us (generate).
 """
 
 from __future__ import annotations
@@ -65,8 +77,211 @@ def percentiles(np, lat_us):
     return float(np.percentile(a, 50)), float(np.percentile(a, 99))
 
 
+def run_generate(args):
+    """fluid-decode drill: open-loop generative traffic + hot swap +
+    solo-parity gate. Returns the process exit code."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import observe, serve
+    from paddle_tpu.models import tiny_lm
+
+    fluid.set_flag("observe", True)
+
+    mdir = args.model_dir
+    if mdir is None:
+        mdir = os.path.join(tempfile.mkdtemp(prefix="serve_loadgen_gen_"),
+                            "model")
+    sig = tiny_lm.save_tiny_lm(
+        mdir, max_slots=8, block_size=4, max_context=48,
+        prefill_rows=(1, 2, 4), prefill_seq_rungs=(8, 16))
+    srv = serve.InferenceServer(
+        fluid.CPUPlace(),
+        serve.ServeConfig(max_queue=args.max_queue, watch_interval_s=0.2,
+                          decode_admission=args.admission))
+    srv.add_model("g", mdir)
+    v0 = srv.registry.get("g").version_id
+
+    rng = random.Random(0)
+    max_prompt = max(sig["prefill_seq_rungs"])
+
+    def make_prompt(r):
+        n = r.randint(2, max_prompt)
+        return [r.randrange(1, sig["vocab"]) for _ in range(n)], \
+            r.randint(1, min(24, sig["max_context"] - n))
+
+    # fixed probe set, decoded SOLO first: under-load generations of the
+    # same prompts (on the same version) must match token-for-token
+    probe_rng = random.Random(1234)
+    probes = [make_prompt(probe_rng) for _ in range(6)]
+    solo = {}
+    for prompt, max_new in probes:
+        res = srv.generate("g", prompt, max_new_tokens=max_new)
+        solo[tuple(prompt) + (max_new,)] = list(res.tokens)
+
+    # everything warmed + solo baselines on the books: any unexpected
+    # observatory event past this line is a steady-state recompile
+    baseline_unexpected = len(observe.observatory().unexpected())
+
+    stop = threading.Event()
+    failures, mismatches = [], []
+    rejected = [0]
+    results = []
+    lock = threading.Lock()
+    inflight = []
+
+    def client(tid):
+        r = random.Random(100 + tid)
+        lam = args.qps / args.threads
+        nxt = time.perf_counter()
+        while not stop.is_set():
+            nxt += r.expovariate(lam)
+            delay = nxt - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            if r.random() < 0.3:
+                prompt, max_new = probes[r.randrange(len(probes))]
+            else:
+                prompt, max_new = make_prompt(r)
+            try:
+                fut = srv.submit_generate("g", prompt,
+                                          max_new_tokens=max_new)
+            except Exception as e:      # noqa: BLE001
+                with lock:
+                    if getattr(e, "retriable", False):
+                        rejected[0] += 1
+                    else:
+                        failures.append(repr(e))
+                continue
+
+            def done(f, prompt=prompt, max_new=max_new):
+                try:
+                    res = f.result()
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        if getattr(e, "retriable", False):
+                            rejected[0] += 1
+                        else:
+                            failures.append(repr(e))
+                    return
+                with lock:
+                    results.append(res)
+                    key = tuple(prompt) + (max_new,)
+                    # parity only against the version the solo ref ran on
+                    if key in solo and res.version_id == v0 \
+                            and res.tokens != solo[key]:
+                        mismatches.append(
+                            {"prompt_len": len(prompt),
+                             "got": res.tokens, "want": solo[key]})
+
+            fut.add_done_callback(done)
+            inflight.append(fut)
+
+    swapped = {"ok": args.no_swap}
+
+    def swap_drill():
+        time.sleep(args.duration / 2)
+        tiny_lm.save_tiny_lm(mdir, max_slots=8, block_size=4,
+                             max_context=48, prefill_rows=(1, 2, 4),
+                             prefill_seq_rungs=(8, 16), scale=1.5)
+        deadline = time.time() + max(10.0, args.duration)
+        while time.time() < deadline:
+            if srv.registry.get("g").version_id != v0:
+                swapped["ok"] = True
+                return
+            time.sleep(0.1)
+
+    srv.start_watch()
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.threads)]
+    if not args.no_swap:
+        threads.append(threading.Thread(target=swap_drill, daemon=True))
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(args.duration)
+    stop.set()
+    for t in threads:
+        t.join(timeout=max(15, args.duration))
+    for f in inflight:
+        try:
+            f.result(timeout=60)
+        except Exception:
+            pass                 # recorded by the callback
+    wall = time.perf_counter() - t0
+
+    tokens = sum(len(r.tokens) for r in results)
+    ttfts = sorted(r.ttft_us for r in results)
+    unexpected = observe.observatory().unexpected()[baseline_unexpected:]
+    stats = srv.stats()["models"]["g"]
+    srv.close()
+
+    def pct(p):
+        if not ttfts:
+            return 0.0
+        return float(ttfts[min(len(ttfts) - 1,
+                               int(p / 100.0 * len(ttfts)))])
+
+    out = {
+        "decode_tokens_per_s": round(tokens / wall, 1),
+        "ttft_p50_us": round(pct(50), 1),
+        "ttft_p99_us": round(pct(99), 1),
+        "decode_generations": len(results),
+        "decode_recompiles": len(unexpected),
+        "decode_failed": len(failures),
+        "decode_rejected": rejected[0],
+        "decode_mismatches": len(mismatches),
+        "decode_hot_swap_ok": bool(swapped["ok"]),
+        "decode_admission": args.admission,
+        "decode_steps": stats["steps"],
+        "decode_avg_occupancy": round(
+            tokens / max(stats["steps"], 1), 2),
+        "decode_offered_qps": args.qps,
+    }
+    print(json.dumps(out))
+
+    rc = 0
+    if unexpected:
+        causes = sorted({e.cause for e in unexpected})
+        print(f"FAIL: {len(unexpected)} steady-state recompile(s), "
+              f"cause(s) {causes}", file=sys.stderr)
+        for e in unexpected:
+            print(f"  {e!r} detail={e.detail}", file=sys.stderr)
+        rc = 1
+    if failures:
+        print(f"FAIL: {len(failures)} failed generation(s); first: "
+              f"{failures[0]}", file=sys.stderr)
+        rc = 1
+    if mismatches:
+        print(f"FAIL: {len(mismatches)} generation(s) mismatched their "
+              f"solo reference (KV aliasing / batching bug); first: "
+              f"{mismatches[0]}", file=sys.stderr)
+        rc = 1
+    if not swapped["ok"]:
+        print("FAIL: hot swap never landed", file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print(f"decode loadgen OK ({args.admission}): "
+              f"{out['decode_tokens_per_s']} tok/s, ttft p50 "
+              f"{out['ttft_p50_us']:.0f} us / p99 "
+              f"{out['ttft_p99_us']:.0f} us, {len(results)} generations, "
+              f"zero steady-state recompiles, solo parity exact",
+              file=sys.stderr)
+    return rc
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description="fluid-serve load generator")
+    ap.add_argument("--workload", choices=("oneshot", "generate"),
+                    default="oneshot",
+                    help="oneshot = padded single-step inference drill; "
+                    "generate = fluid-decode continuous-batching drill")
+    ap.add_argument("--admission", choices=("continuous", "drain"),
+                    default="continuous",
+                    help="generate workload: slot-admission policy "
+                    "(drain = the drain-and-refill A/B baseline)")
     ap.add_argument("--model-dir", help="existing save_inference_model dir "
                     "with a single feed named 'x' (default: build a tiny "
                     "MLP in a tempdir)")
@@ -86,6 +301,9 @@ def main(argv=None):
     ap.add_argument("--no-swap", action="store_true",
                     help="skip the mid-run hot-swap drill")
     args = ap.parse_args(argv)
+
+    if args.workload == "generate":
+        return run_generate(args)
 
     import jax
     jax.config.update("jax_platforms", "cpu")
