@@ -425,6 +425,35 @@ def decode_loadgen_subprocess():
     return out
 
 
+def planner_subprocess(peak_tflops, measured_mfu):
+    """fluid-planner agreement segment (tools/paddle_plan.py, CPU
+    subprocess — the plan is a static walk, no device work): predicted
+    MFU of the bench transformer from the roofline cost model, against
+    the MFU this very run measured. plan_agreement = predicted/measured
+    is the health gate on the planner's calibration — the mesh search
+    and HBM gate rank with the same model."""
+    rec, rc = _tool_json(
+        "paddle_plan.py", "planner plan",
+        args=("--model", "transformer", "--full-size", "--devices", "1",
+              "--hw", "tpu", "--peak-tflops", f"{peak_tflops:.1f}",
+              "--json"))
+    if rec is None or not (rec.get("best") or {}).get("mfu"):
+        return {"plan_predicted_mfu": 0.0,
+                "plan_measured_mfu": round(measured_mfu, 3),
+                "plan_agreement": 0.0}
+    best = rec["best"]
+    return {
+        "plan_predicted_mfu": round(best["mfu"], 3),
+        "plan_measured_mfu": round(measured_mfu, 3),
+        "plan_agreement": round(best["mfu"] / measured_mfu, 3)
+        if measured_mfu > 0 else 0.0,
+        "plan_predicted_step_us": best.get("step_time_us", 0.0),
+        "plan_predicted_peak_hbm_gb": round(
+            best.get("peak_hbm_bytes", 0) / 1e9, 2),
+        "plan_rc": rc,
+    }
+
+
 def tpu_gated_tests():
     """The TPU-gated flash-dropout + long-context suites must pass on the
     CURRENT build at bench time (round-4 verdict item 10)."""
@@ -886,6 +915,14 @@ def main():
         ips, rn_fps = ips2, rn_fps2
     _PARTIAL["value"] = round(ips, 2)   # keep the partial record adopted
     note(resnet50_mfu=round(rn_fps / peak, 3))
+    # fluid-planner: predicted-vs-measured MFU on the headline model,
+    # with THIS run's measured peak and the final (keep-the-max) MFU —
+    # plan_agreement ~1.0 means the mesh/HBM/flag rankings upstream of
+    # auto_mesh are computed from an honest time model
+    _PARTIAL["extra"]["failure_stage"] = "planner_subprocess"
+    _obs.flight.set_stage("planner_subprocess")
+    plan = planner_subprocess(peak / 1e12, tf_fps / peak if peak else 0.0)
+    note(**plan)
     _PARTIAL["extra"]["failure_stage"] = "tpu_gated_tests"
     _obs.flight.set_stage("tpu_gated_tests")
     gated = tpu_gated_tests()
@@ -959,6 +996,11 @@ def main():
         "resnet50_images_per_sec_remeasure": round(ips2, 2),
         "resnet50_mfu_first": round(rn_fps_first / peak, 3),
         "resnet50_mfu_remeasure": round(rn_fps2 / peak, 3),
+        # fluid-planner (CPU subprocess): the roofline model's predicted
+        # MFU for the headline transformer vs what this run measured
+        "plan_predicted_mfu": plan.get("plan_predicted_mfu", 0.0),
+        "plan_measured_mfu": plan.get("plan_measured_mfu", 0.0),
+        "plan_agreement": plan.get("plan_agreement", 0.0),
         "tpu_gated_tests": gated,
     }
     # normal completion: no stage is "failing"; soft failures (sentinel

@@ -22,7 +22,13 @@ from __future__ import annotations
 from typing import List, Optional, Sequence
 
 from ..core import ir
-from .cost_model import CostReport, OpCost, estimate_cost  # noqa: F401
+from .cost_model import (CostReport, OpCost, estimate_cost,  # noqa: F401
+                         estimate_peak_hbm, shape_env)
+from .planner import (CPU_REHEARSAL, TPU_CHIP, HardwareSpec,  # noqa: F401
+                      MeshPlan, PlanReport, cost_profile,
+                      detect_hardware, enumerate_meshes,
+                      estimate_step_time, flag_family_priors,
+                      optimal_rungs, plan_meshes)
 from .diagnostics import (Diagnostic, ProgramVerificationError,  # noqa: F401
                           Severity, format_diagnostics, has_errors,
                           lint_dead_fetch_targets, lint_program,

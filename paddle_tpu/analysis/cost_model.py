@@ -347,14 +347,23 @@ def _matmul_flops(op, env, block, default_dim) -> float:
         w_shape = _shape_of(env, block, w, default_dim) if w else None
         if w_shape is None or len(w_shape) < 4:
             return 2.0 * _nelems(out_shape)
-        # filter [Cout, Cin/groups, kh, kw] (NCHW) or [kh, kw, Cin/g, Cout]
-        # — either way the per-output-element multiply count is the filter
-        # volume without its Cout axis (grouping is already folded into
-        # the filter's Cin/g extent)
-        if op.attrs.get("data_format", "NCHW") in ("NHWC", "NDHWC"):
+        # The per-output-element multiply count is the filter volume
+        # without its Cout axis (grouping is already folded into the
+        # filter's Cin/g extent). `data_format` describes the DATA
+        # layout, not the filter's: this DSL stores filters OIHW
+        # ([Cout, Cin/g, kh, kw]) for both NCHW and NHWC data — so find
+        # the Cout axis by matching the output's channel extent instead
+        # of trusting the data layout (the old NHWC branch read
+        # Cout·Cin·kh here, inflating ResNet-50 ~300x).
+        nhwc = op.attrs.get("data_format", "NCHW") in ("NHWC", "NDHWC")
+        cout = out_shape[-1] if nhwc else (
+            out_shape[1] if len(out_shape) > 1 else out_shape[-1])
+        if w_shape[0] == cout:
+            per_out = _nelems(w_shape[1:])
+        elif w_shape[-1] == cout:
             per_out = _nelems(w_shape[:-1])
         else:
-            per_out = _nelems(w_shape[1:])
+            per_out = _nelems(w_shape) / max(float(cout), 1.0)
         return 2.0 * _nelems(out_shape) * per_out
     x = _first(op, "X")
     x_shape = _shape_of(env, block, x, default_dim) if x else None
@@ -369,6 +378,26 @@ def _matmul_flops(op, env, block, default_dim) -> float:
     return 2.0 * _nelems(out_shape) * float(max(int(k), 1))
 
 
+def _attention_flops(op, env, block, default_dim) -> float:
+    """fused_attention [B,H,Tq,Dh]x[B,H,Tk,Dh]: the two dots QK^T and
+    W·V (2·M·K·N each => 4·Dh per score) plus softmax's ~3
+    non-transcendental flops per score — what XLA counts for the
+    equivalent unfused chain, so fused and unfused programs cost the
+    same math."""
+    q = _first(op, "Q")
+    k = _first(op, "K")
+    q_shape = _shape_of(env, block, q, default_dim) if q else None
+    k_shape = _shape_of(env, block, k, default_dim) if k else None
+    if q_shape is None or k_shape is None or len(q_shape) < 2 \
+            or len(k_shape) < 2:
+        out = next((n for n in op.output_arg_names if n != EMPTY_VAR),
+                   None)
+        out_shape = _shape_of(env, block, out, default_dim) if out else None
+        return 2.0 * _nelems(out_shape) if out_shape else 0.0
+    return ((4.0 * q_shape[-1] + 3.0)
+            * _nelems(q_shape[:-1]) * float(k_shape[-2]))
+
+
 def _op_flops(op, env, block, default_dim, fwd_by_out) -> float:
     t = op.type
     out_names = [n for n in op.output_arg_names if n != EMPTY_VAR]
@@ -379,8 +408,18 @@ def _op_flops(op, env, block, default_dim, fwd_by_out) -> float:
         return 0.0
     if t in _MATMUL_LIKE:
         return _matmul_flops(op, env, block, default_dim)
+    if t == "fused_attention":
+        return _attention_flops(op, env, block, default_dim)
     if t.endswith(GRAD_OP_SUFFIX):
         base = t[: -len(GRAD_OP_SUFFIX)]
+        if base == "fused_attention":
+            # flash backward: dV, dW, dQ, dK plus the W recompute —
+            # ~2.5x the forward's dot work
+            og = _first(op, "OutGrad")
+            fwd = fwd_by_out.get(og.split(ir.GRAD_SUFFIX)[0]) if og else None
+            if fwd is not None:
+                return 2.5 * _attention_flops(fwd, env, block, default_dim)
+            return 2.0 * out_elems
         if base in _MATMUL_LIKE:
             # one full product per produced input-grad (the 2x-forward
             # rule), costed from the forward op that made OutGrad's base
@@ -454,6 +493,22 @@ def estimate_cost(program: ir.Program,
                 param_bytes += _nbytes(
                     (_resolve(v.shape, default_dim), v.dtype))
     return CostReport(ops, param_bytes, unresolved)
+
+
+def shape_env(program: ir.Program,
+              feed_shapes: Dict[str, Sequence[int]],
+              default_dim: Optional[int] = None) -> Dict[str, ShapeDtype]:
+    """The concrete {var: (shape, dtype)} environment `estimate_cost`
+    walks — exposed for consumers that need per-tensor shapes next to
+    the per-op table (the planner's communication model sizes ring/
+    all-reduce payloads from the actual attention/grad tensors)."""
+    if default_dim is None:
+        default_dim = 1
+        for shape in feed_shapes.values():
+            if len(shape) and int(shape[0]) > 0:
+                default_dim = int(shape[0])
+                break
+    return _concrete_env(program, feed_shapes, default_dim, [])
 
 
 def estimate_peak_hbm(program: ir.Program,

@@ -7,4 +7,4 @@ all-gather / reduce-scatter) that the reference issued by hand.
 """
 
 from .parallel_executor import ParallelExecutor, BuildStrategy, ExecutionStrategy  # noqa: F401
-from .mesh import get_default_mesh, make_mesh  # noqa: F401
+from .mesh import auto_mesh, get_default_mesh, make_mesh  # noqa: F401

@@ -5,11 +5,15 @@ The reference enumerates CUDAPlaces and builds NCCL communicators per device
 `jax.sharding.Mesh` over all local (or all distributed) devices; axes are
 named so programs can shard over data ('dp'), model ('mp'/'tp'), pipeline
 ('pp'), and sequence ('sp') dimensions.
+
+fluid-planner: `auto_mesh(program, n_devices)` derives the dp×mp×sp
+split from the program's cost model instead of a hand-picked tuple —
+see `analysis.planner.plan_meshes` and docs/PLANNER.md.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 import jax
@@ -32,6 +36,64 @@ def get_default_mesh(num_devices: Optional[int] = None) -> Mesh:
     if num_devices is not None:
         devices = devices[:num_devices]
     return make_mesh([len(devices)], ["dp"], devices)
+
+
+def auto_mesh(program, n_devices: Optional[int] = None,
+              feed_shapes: Optional[Dict[str, Sequence[int]]] = None,
+              devices=None, hw=None, default_batch: int = 8,
+              return_report: bool = False):
+    """Cost-model-driven mesh selection (fluid-planner): search the
+    dp×mp×sp factorizations of `n_devices` for `program` and build the
+    Mesh of the fastest-predicted feasible candidate. Callers that used
+    to hand-tune `make_mesh([dp, mp, sp], ...)` can drop the tuple:
+
+        mesh = auto_mesh(main_program, n_devices=8)
+        pe = ParallelExecutor(main_program=main, loss_name=loss.name,
+                              mesh=mesh, scope=scope)
+
+    `feed_shapes` sizes the batch/sequence extents the feasibility and
+    cost models use; when omitted, the program's data vars are read with
+    any -1 batch dim resolved to `default_batch`. `hw` is an
+    `analysis.planner.HardwareSpec` (default: detected from the jax
+    backend — the calibrated chip profile on TPU, the virtual-device
+    rehearsal profile on CPU). `return_report=True` also returns the
+    ranked `PlanReport` (predicted step time / MFU / peak HBM /
+    bytes-on-the-wire per candidate). Raises ValueError when no
+    candidate is feasible, naming each rejection."""
+    from ..analysis import planner as _planner
+
+    devices = list(devices) if devices is not None else jax.devices()
+    n = int(n_devices) if n_devices is not None else len(devices)
+    if feed_shapes is None:
+        # only the BATCH dim may be defaulted: a non-batch -1 (dynamic
+        # sequence/spatial axis) has no sane default, and planning sp
+        # feasibility or ring-attention cost at a made-up extent would
+        # silently mis-rank the mesh — the caller must say what the
+        # real workload looks like
+        feed_shapes = {}
+        for v in program.global_block().vars.values():
+            if not getattr(v, "is_data", False) or v.shape == ():
+                continue
+            shape = [int(d) for d in v.shape]
+            if any(d == -1 for d in shape[1:]):
+                raise ValueError(
+                    f"auto_mesh: data var {v.name!r} has a dynamic "
+                    f"non-batch dim {tuple(shape)} — pass feed_shapes= "
+                    f"with the concrete extents the workload will run")
+            if shape and shape[0] == -1:
+                shape[0] = int(default_batch)
+            feed_shapes[v.name] = tuple(shape)
+    report = _planner.plan_meshes(program, feed_shapes, n, hw=hw)
+    best = report.best
+    if best is None:
+        reasons = "; ".join(f"{c.label()}: {c.reason}"
+                            for c in report.candidates)
+        raise ValueError(
+            f"auto_mesh: no feasible dp*mp*sp split of {n} device(s) "
+            f"for this program — {reasons}")
+    mesh = make_mesh([best.dp, best.mp, best.sp], ["dp", "mp", "sp"],
+                     devices[:n])
+    return (mesh, report) if return_report else mesh
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -26,7 +26,8 @@ from __future__ import annotations
 
 from .batcher import MicroBatcher, SlotScheduler  # noqa: F401
 from .bucketing import (DEFAULT_ROWS_LADDER, BucketLadder,  # noqa: F401
-                        plan_request, warm_feed_shapes)
+                        load_trace, plan_request, predicted_padding_waste,
+                        save_trace, trace_request, warm_feed_shapes)
 from .decode import (DecodeEngine, GenerationResult,  # noqa: F401
                      GenerationStream)
 from .errors import (BadRequestError, CacheExhaustedError,  # noqa: F401

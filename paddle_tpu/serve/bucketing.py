@@ -27,6 +27,7 @@ are bit-identical to an unpadded run (pinned by tests/test_serve.py).
 
 from __future__ import annotations
 
+import json
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -72,6 +73,77 @@ class BucketLadder:
         raise BadRequestError(
             f"request has {n} rows but the ladder tops out at "
             f"{self.max_rows} — split the request or extend the ladder")
+
+    @classmethod
+    def from_trace(cls, trace, max_rungs: int = 8, dim_max_rungs: int = 4,
+                   max_warm: int = MAX_WARM_BUCKETS) -> "BucketLadder":
+        """fluid-planner: derive the ladder FROM TRAFFIC instead of
+        hand-configuring it. `trace` is a request-shape trace — the dict
+        `load_trace` returns (or a bare list of its ``requests``
+        entries), as emitted by `tools/serve_loadgen.py --emit-trace`:
+        each request records its row count and the extent of every
+        dynamic non-batch axis.
+
+        Rung selection is the exact padding-waste-minimizing partition
+        (`analysis.planner.optimal_rungs`): per axis, ≤ `max_rungs`
+        (rows) / `dim_max_rungs` (each dynamic dim) rung values
+        minimizing total padded units over the trace. The warm-compile
+        budget is enforced up front: the rows ladder shrinks until
+        rows-rungs × dim-rung combinations fit `max_warm`, so the
+        derived ladder always warm-compiles (`warm_feed_shapes` cannot
+        raise) and steady-state traffic shaped like the trace produces
+        ZERO `padding_bucket` misses.
+
+        Model note: this minimizes REQUEST-level padding. Coalescing
+        packs multiple requests per batch, so measured per-batch waste
+        under load is at or below this bound (the loadgen drill
+        verifies against the observatory)."""
+        reqs = trace.get("requests") if isinstance(trace, dict) else trace
+        if not reqs:
+            raise BadRequestError("from_trace: empty request trace")
+        from ..analysis.planner import optimal_rungs
+
+        # per-axis extents, each weighted by the request's CELL count
+        # over the other axes (rows x other dims): the DP then minimizes
+        # padded cells — predicted_padding_waste's exact objective — not
+        # per-axis padded units (which lets a rarely-hit-but-huge axis
+        # combination dominate the real waste)
+        def _cells(r, skip=None):
+            w = float(r["rows"])
+            for feed, axes in (r.get("dims") or {}).items():
+                for ax, extent in axes.items():
+                    if (feed, int(ax)) != skip:
+                        w *= int(extent)
+            return w
+
+        rows, rows_w = [], []
+        for r in reqs:
+            rows.append(int(r["rows"]))
+            rows_w.append(_cells(r) / max(int(r["rows"]), 1))
+        dim_extents: Dict[Tuple[str, int], List[int]] = {}
+        dim_weights: Dict[Tuple[str, int], List[float]] = {}
+        for r in reqs:
+            for feed, axes in (r.get("dims") or {}).items():
+                for ax, extent in axes.items():
+                    key = (feed, int(ax))
+                    dim_extents.setdefault(key, []).append(int(extent))
+                    dim_weights.setdefault(key, []).append(
+                        _cells(r, skip=key))
+        dims: Dict[str, Dict[int, Tuple[int, ...]]] = {}
+        combos = 1
+        for (feed, ax), extents in sorted(dim_extents.items()):
+            rungs = optimal_rungs(extents, dim_max_rungs,
+                                  weights=dim_weights[(feed, ax)])
+            dims.setdefault(feed, {})[ax] = rungs
+            combos *= len(rungs)
+        if combos > max_warm:
+            raise BadRequestError(
+                f"from_trace: {combos} dim-rung combinations exceed the "
+                f"{max_warm} warm-compile budget even before the rows "
+                f"ladder — lower dim_max_rungs")
+        rows_budget = min(int(max_rungs), max(1, max_warm // combos))
+        return cls(rows=optimal_rungs(rows, rows_budget, weights=rows_w),
+                   dims=dims)
 
     def dim_rung(self, name: str, axis: int, extent: int) -> int:
         rungs = self.dims.get(name, {}).get(axis)
@@ -205,6 +277,61 @@ def concat_requests(reqs: Sequence[PlannedRequest]
     feeds = {n: np.concatenate([r.feeds[n] for r in reqs], axis=0)
              for n in names}
     return feeds, sum(r.rows for r in reqs)
+
+
+TRACE_VERSION = 1
+
+
+def trace_request(rows: int, dims: Optional[Dict[str, Dict[int, int]]]
+                  = None, ts: Optional[float] = None) -> dict:
+    """One request-shape trace entry in the `from_trace` format."""
+    return {"ts": float(ts or 0.0), "rows": int(rows),
+            "dims": {feed: {int(ax): int(e) for ax, e in axes.items()}
+                     for feed, axes in (dims or {}).items()}}
+
+
+def save_trace(path: str, requests: Sequence[dict]) -> None:
+    """Write a request-shape trace (`--emit-trace` format): one JSON
+    document, `{"version": 1, "requests": [{ts, rows, dims}, ...]}`."""
+    with open(path, "w") as f:
+        json.dump({"version": TRACE_VERSION,
+                   "requests": list(requests)}, f)
+
+
+def load_trace(path: str) -> dict:
+    """Read a `save_trace` document; validates the shape `from_trace`
+    consumes and raises BadRequestError naming what is malformed."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "requests" not in doc:
+        raise BadRequestError(
+            f"trace {path!r}: expected a JSON object with a 'requests' "
+            f"list (save_trace / --emit-trace format)")
+    for i, r in enumerate(doc["requests"]):
+        if not isinstance(r, dict) or "rows" not in r:
+            raise BadRequestError(
+                f"trace {path!r}: request {i} has no 'rows' field")
+    return doc
+
+
+def predicted_padding_waste(ladder: BucketLadder, trace) -> float:
+    """The request-level padded-unit fraction the ladder implies for a
+    trace: 1 − Σ(real cells)/Σ(padded cells), counting the rows axis ×
+    every traced dynamic axis. This is `from_trace`'s objective — an
+    upper-bound-flavored proxy for the batcher's measured per-batch
+    `serve_padding_waste_ratio` (coalescing only packs batches fuller)."""
+    reqs = trace.get("requests") if isinstance(trace, dict) else trace
+    real = padded = 0.0
+    for r in reqs:
+        rows = int(r["rows"])
+        cells, pcells = float(rows), float(ladder.rows_rung(rows))
+        for feed, axes in (r.get("dims") or {}).items():
+            for ax, extent in axes.items():
+                cells *= int(extent)
+                pcells *= ladder.dim_rung(feed, int(ax), int(extent))
+        real += cells
+        padded += pcells
+    return 1.0 - real / padded if padded else 0.0
 
 
 def warm_feed_shapes(spec: Dict[str, Tuple[Tuple[int, ...], str]],

@@ -116,11 +116,16 @@ def test_pure_sp_mesh_small_batch():
 
 def test_ring_specs_carry_dp_axis():
     """shard_map specs must name dp/mp too, else GSPMD all-gathers the
-    batch into every dp group (review regression). With dp in the specs
-    the lowered module shards dim 0 of the attention inputs."""
+    batch into every dp group (review regression). The old check
+    pattern-matched `manual_axes={...}` in the StableHLO text, which
+    drifted across jax releases; assert the STRUCTURAL consequences on
+    the compiled module's collective inventory instead: the ring's
+    collective-permutes are present, the dp gradient all-reduce is
+    present, and — the actual regression — no all-gather materializes
+    the gathered batch inside the step."""
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices")
-    import re
+    from paddle_tpu.parallel.parallel_executor import collective_inventory
     main, startup, loss = _build(32, dropout=0.0)
     scope = fluid.Scope()
     fluid.Executor(fluid.CPUPlace()).run(startup, scope=scope)
@@ -131,13 +136,13 @@ def test_ring_specs_carry_dp_axis():
     src = rng.randint(1, 64, (8, 32)).astype(np.int32)
     batch = {k: src for k in ("src_word", "trg_word", "lbl_word")}
     pe.run(feed=batch, fetch_list=[loss.name])
-    txt = pe.lowered_text(batch)
-    # every manual (shard_map) computation over the ring must be manual on
-    # BOTH dp and sp — a {manual_axes={"sp"}} with dp unlisted means the
-    # batch was gathered
-    manuals = re.findall(r'in_shardings=.{0,400}?manual_axes=\{([^}]*)\}',
-                         txt) or re.findall(r'manual_axes\s*=\s*\{([^}]*)\}',
-                                            txt)
-    assert manuals, "no shard_map in lowered module"
-    for axes in manuals:
-        assert "dp" in axes and "sp" in axes, f"manual axes only {{{axes}}}"
+    inv = collective_inventory(pe.compiled_text(batch))
+    # the ring really runs inside the compiled step
+    assert inv.get("collective-permute", 0) > 0, f"no ring permutes: {inv}"
+    # dp grad reduction survives next to the ring
+    assert inv.get("all-reduce", 0) > 0, f"no dp all-reduce: {inv}"
+    # the regression signature: dp missing from the manual specs makes
+    # GSPMD all-gather the batch into every dp group before the ring
+    assert inv.get("all-gather", 0) == 0, (
+        f"batch all-gathered into the ring (dp dropped from the "
+        f"shard_map specs?): {inv}")

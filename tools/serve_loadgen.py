@@ -294,6 +294,15 @@ def main(argv=None):
                     help="open-loop offered load (default 300)")
     ap.add_argument("--buckets", default="1,2,4,8",
                     help="rows ladder (default 1,2,4,8)")
+    ap.add_argument("--emit-trace", metavar="PATH",
+                    help="oneshot workload: dump the request-shape trace "
+                    "(rows + per-feed dynamic dims with timestamps) in "
+                    "the serve.BucketLadder.from_trace format, so real "
+                    "traffic can re-derive the ladder offline")
+    ap.add_argument("--ladder-from", metavar="PATH",
+                    help="oneshot workload: derive the ladder from a "
+                    "recorded --emit-trace file (fluid-planner "
+                    "auto-sizing) instead of --buckets")
     ap.add_argument("--batch-timeout-ms", type=float, default=2.0)
     ap.add_argument("--max-queue", type=int, default=512)
     ap.add_argument("--deadline-ms", type=float, default=None,
@@ -303,6 +312,12 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     if args.workload == "generate":
+        if args.emit_trace or args.ladder_from:
+            # fail at launch, not after an expensive silent run: the
+            # shape trace / derived ladder are oneshot-workload features
+            # (prefill ladders auto-derive from the decode signature)
+            ap.error("--emit-trace/--ladder-from apply to the oneshot "
+                     "workload only")
         return run_generate(args)
 
     import jax
@@ -320,13 +335,21 @@ def main(argv=None):
                             "model")
         build_and_save(fluid, np, mdir)
 
-    rows_ladder = tuple(int(b) for b in args.buckets.split(","))
+    if args.ladder_from:
+        ladder = serve.BucketLadder.from_trace(
+            serve.load_trace(args.ladder_from))
+        print(f"ladder derived from {args.ladder_from}: rows "
+              f"{list(ladder.rows)} dims {ladder.dims}", file=sys.stderr)
+    else:
+        ladder = serve.BucketLadder(
+            rows=tuple(int(b) for b in args.buckets.split(",")))
+    rows_ladder = ladder.rows
     srv = serve.InferenceServer(
         fluid.CPUPlace(),
         serve.ServeConfig(batch_timeout_ms=args.batch_timeout_ms,
                           max_queue=args.max_queue,
                           watch_interval_s=0.2))
-    srv.add_model("m", mdir, ladder=serve.BucketLadder(rows=rows_ladder))
+    srv.add_model("m", mdir, ladder=ladder)
     feat = srv.registry.get("m").spec["x"][0][1]   # feature width
 
     # everything the warmup compiled is on the books now; any unexpected
@@ -341,8 +364,15 @@ def main(argv=None):
     rejected = [0]
     fail_lock = threading.Lock()
 
+    # request-shape trace for --emit-trace (list.append is GIL-atomic, so
+    # client threads record without a lock; the MLP's only dynamic axis
+    # is rows — dims stays empty and from_trace learns the rows ladder)
+    shape_trace = []
+
     def make_feed():
         n = rng.randint(1, max_req_rows)
+        if args.emit_trace:
+            shape_trace.append(serve.trace_request(rows=n, ts=time.time()))
         return {"x": np.random.randn(n, feat).astype(np.float32)}
 
     def record_failure(e):
@@ -452,6 +482,11 @@ def main(argv=None):
     unexpected = observe.observatory().unexpected()[baseline_unexpected:]
     recompiles = len(unexpected)
     srv.close()
+
+    if args.emit_trace:
+        serve.save_trace(args.emit_trace, shape_trace)
+        print(f"wrote {len(shape_trace)} request shapes to "
+              f"{args.emit_trace}", file=sys.stderr)
 
     p50, p99 = percentiles(np, open_lat)
     c50, c99 = percentiles(np, closed_lat)
