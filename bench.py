@@ -425,6 +425,94 @@ def decode_loadgen_subprocess():
     return out
 
 
+def fleet_subprocess():
+    """fluid-fleet numbers (tools/serve_loadgen.py --replicas N + the
+    replica_kill chaos drill; replicas are SUBPROCESSES, the router is
+    in-process host python): the 1-vs-3 replica QPS scaling curve
+    (acceptance: >= 2.5x at N=3), the skew-free coordinated swap under
+    load, p99 across a mid-run replica SIGKILL with ZERO failed
+    requests, and the end-to-end DeepFM drill whose embedding tables
+    live only in pserver shards.
+
+    Rehearsal-rig honesty: on a real fleet each replica's step runs on
+    its own TPU chip, so host CPU is not what a replica count scales.
+    This container is 1-core, so each replica SIMULATES its device time
+    (--device-ms, serialized per replica, recorded in the JSON as
+    fleet_device_ms_simulated) and the segment measures what the fleet
+    tier actually adds: router dispatch, RPC, membership and failover
+    overhead — the part that could destroy linear chip scaling."""
+    import subprocess
+
+    DEV_MS = "6"
+    common = ("--duration", "6", "--qps", "600", "--threads", "24",
+              "--device-ms", DEV_MS, "--no-swap")
+    one, rc1 = _tool_json("serve_loadgen.py", "fleet loadgen (1 replica)",
+                          args=("--replicas", "1") + common, timeout=300)
+    three, rc3 = _tool_json("serve_loadgen.py",
+                            "fleet loadgen (3 replicas + swap)",
+                            args=("--replicas", "3", "--duration", "6",
+                                  "--qps", "600", "--threads", "24",
+                                  "--device-ms", DEV_MS), timeout=300)
+    dfm, rc_d = _tool_json("serve_loadgen.py",
+                           "fleet loadgen (deepfm sparse)",
+                           args=("--replicas", "2", "--duration", "5",
+                                 "--qps", "60", "--threads", "6",
+                                 "--fleet-model", "deepfm-sparse",
+                                 "--sparse-quant", "int8"), timeout=300)
+    if one is None or three is None:
+        return {"fleet_qps_1": 0.0, "fleet_qps_3": 0.0,
+                "fleet_qps_scaling_x": 0.0, "fleet_p99_under_kill_us": 0.0}
+    q1 = one.get("fleet_qps", 0.0)
+    q3 = three.get("fleet_qps", 0.0)
+    out = {
+        "fleet_qps_1": q1,
+        "fleet_qps_3": q3,
+        "fleet_qps_scaling_x": round(q3 / q1, 2) if q1 else 0.0,
+        "fleet_p99_us_3": three.get("fleet_p99_us", 0.0),
+        "fleet_swap_skew_violations": three.get(
+            "fleet_skew_violations", -1),
+        "fleet_swap_ok": three.get("fleet_swap_ok", False),
+        "fleet_recompiles": (one.get("fleet_recompiles", 0)
+                             + three.get("fleet_recompiles", 0)),
+        "fleet_device_ms_simulated": float(DEV_MS),
+    }
+    if rc1 or rc3:
+        out["fleet_loadgen_rc"] = rc1 or rc3
+    if dfm is not None:
+        out["fleet_deepfm_qps"] = dfm.get("fleet_qps", 0.0)
+        out["fleet_deepfm_failed"] = dfm.get("fleet_failed", -1)
+        sp = next(iter((dfm.get("fleet_sparse") or {}).values()), {})
+        m = next(iter(sp.values()), {}) if sp else {}
+        out["fleet_deepfm_cache_hits"] = m.get("cache_hits", 0)
+        out["fleet_deepfm_cache_misses"] = m.get("cache_misses", 0)
+        if rc_d:
+            out["fleet_deepfm_rc"] = rc_d
+    # the replica-kill drill: p99 pre/post SIGKILL, zero failed gate
+    try:
+        drill = subprocess.run(
+            [sys.executable, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools", "chaos_drill.py"),
+             "--scenario", "replica_kill"],
+            capture_output=True, text=True, timeout=300)
+        line = [l for l in drill.stdout.splitlines()
+                if l.startswith("{")][-1]
+        kill = json.loads(line)
+        out["fleet_p99_under_kill_us"] = kill.get(
+            "fleet_p99_post_kill_us", 0.0)
+        out["fleet_p99_pre_kill_us"] = kill.get(
+            "fleet_p99_pre_kill_us", 0.0)
+        out["fleet_kill_failed_requests"] = kill.get(
+            "fleet_kill_failed", -1)
+        if drill.returncode:
+            out["fleet_kill_drill_rc"] = drill.returncode
+    except Exception as e:
+        print(f"WARNING: replica_kill drill failed ({e!r})",
+              file=sys.stderr)
+        out["fleet_p99_under_kill_us"] = 0.0
+        out["fleet_kill_failed_requests"] = -1
+    return out
+
+
 def planner_subprocess(peak_tflops, measured_mfu):
     """fluid-planner agreement segment (tools/paddle_plan.py, CPU
     subprocess — the plan is a static walk, no device work): predicted
@@ -873,6 +961,13 @@ def main():
     _obs.flight.set_stage("decode_loadgen_subprocess")
     dec = decode_loadgen_subprocess()
     note(**dec)
+    # fluid-fleet: multi-replica QPS scaling (subprocess replicas behind
+    # the router), skew-free coordinated swap, p99 across a replica
+    # SIGKILL with zero failed requests, DeepFM-from-pserver-shards
+    _PARTIAL["extra"]["failure_stage"] = "fleet_subprocess"
+    _obs.flight.set_stage("fleet_subprocess")
+    fleet_rec = fleet_subprocess()
+    note(**fleet_rec)
     # fluid-wire: quantized PS wire A/B (bytes/step raw vs encoded, sync-PS
     # step time both modes, sparse-row compression, loss-delta neutrality)
     _PARTIAL["extra"]["failure_stage"] = "wire_bench_subprocess"

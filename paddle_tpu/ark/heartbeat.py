@@ -24,17 +24,27 @@ class HeartbeatThread:
     `lease_s` is the server-side lease duration; the renewal interval
     defaults to a third of it. Failures are swallowed (and metered when
     `observe` is on): the lease simply expires if the server is gone,
-    which is exactly the signal the eviction path wants."""
+    which is exactly the signal the eviction path wants.
 
-    def __init__(self, client, endpoints: Sequence[str], trainer_id: int,
-                 session=None, lease_s: float = 3.0,
-                 interval: Optional[float] = None):
+    fluid-fleet reuse: pass `beat=<callable>` instead of a
+    client/endpoints pair to renew an arbitrary lease (a serving replica
+    renewing its membership lease on the router) on the same
+    interval/failure-swallowing contract — the callable does one renewal
+    and raises on failure."""
+
+    def __init__(self, client=None, endpoints: Sequence[str] = (),
+                 trainer_id: int = 0, session=None, lease_s: float = 3.0,
+                 interval: Optional[float] = None, beat=None):
+        if beat is None and client is None:
+            raise ValueError("HeartbeatThread needs a client+endpoints "
+                             "pair or a beat callable")
         self.client = client
         self.endpoints = list(endpoints)
         self.trainer_id = int(trainer_id)
         self.session = session
         self.lease_s = float(lease_s)
         self.interval = float(interval) if interval else self.lease_s / 3.0
+        self._beat = beat
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -49,11 +59,27 @@ class HeartbeatThread:
 
     def beat_once(self) -> int:
         """One renewal round, all endpoints CONCURRENTLY (the client's
-        per-endpoint pool); returns how many acknowledged. Concurrency
+        per-endpoint pool); returns how many acknowledged. With a
+        custom `beat` callable, one invocation (miss swallowed + metered
+        under endpoint="custom", same contract). Concurrency
         matters: renewed serially, one blackholed pserver's deadline
         would delay renewals to the healthy ones past the lease and get
         this live trainer falsely evicted. Used synchronously at startup
         so the lease exists before the first sync barrier."""
+        if self._beat is not None:
+            try:
+                self._beat()
+                return 1
+            except Exception as e:
+                from .. import flags as _flags
+                from ..observe import metrics as _metrics
+                if _flags.get_flag("observe"):
+                    _metrics.counter(
+                        "ark_heartbeat_misses_total",
+                        "heartbeat renewals that failed").inc(
+                            endpoint="custom")
+                logger.debug("custom heartbeat failed: %s", e)
+                return 0
         futs = {ep: self.client._pool.submit(
                     self.client.heartbeat, ep, trainer_id=self.trainer_id,
                     session=self.session, lease_s=self.lease_s)

@@ -27,41 +27,51 @@ BrokenBarrierError = threading.BrokenBarrierError
 
 
 class LeaseTable:
-    """Per-trainer heartbeat leases: `beat` renews, `expired` lists
-    leaseholders past their expiry. A trainer is only ever evictable
-    after it has held a lease — unknown trainers are not tracked."""
+    """Per-member heartbeat leases: `beat` renews, `expired` lists
+    leaseholders past their expiry. A member is only ever evictable
+    after it has held a lease — unknown members are not tracked.
+
+    Member ids are integers on the trainer path (trainer_id over the
+    pserver RPC) and strings on the fluid-fleet path (replica ids like
+    ``"r0@127.0.0.1:4471"`` heartbeating the serving router); `_key`
+    keeps the legacy int coercion for numeric ids (np.int64 over the
+    wire) while passing strings through untouched."""
+
+    @staticmethod
+    def _key(member):
+        return member if isinstance(member, str) else int(member)
 
     def __init__(self):
         self._lock = threading.Lock()
-        # trainer_id -> (session, expires_at_monotonic, lease_s)
-        self._leases: Dict[int, Tuple[object, float, float]] = {}
+        # member id -> (session, expires_at_monotonic, lease_s)
+        self._leases: Dict[object, Tuple[object, float, float]] = {}
 
-    def beat(self, trainer_id: int, session=None,
+    def beat(self, trainer_id, session=None,
              lease_s: float = 3.0) -> None:
         with self._lock:
-            self._leases[int(trainer_id)] = (
+            self._leases[self._key(trainer_id)] = (
                 session, time.monotonic() + float(lease_s), float(lease_s))
 
-    def session_of(self, trainer_id: int):
+    def session_of(self, trainer_id):
         with self._lock:
-            rec = self._leases.get(int(trainer_id))
+            rec = self._leases.get(self._key(trainer_id))
             return rec[0] if rec else None
 
-    def live(self) -> Iterable[int]:
+    def live(self) -> Iterable:
         now = time.monotonic()
         with self._lock:
             return [t for t, (_s, exp, _l) in self._leases.items()
                     if exp > now]
 
-    def expired(self) -> Iterable[int]:
+    def expired(self) -> Iterable:
         now = time.monotonic()
         with self._lock:
             return [t for t, (_s, exp, _l) in self._leases.items()
                     if exp <= now]
 
-    def forget(self, trainer_id: int) -> None:
+    def forget(self, trainer_id) -> None:
         with self._lock:
-            self._leases.pop(int(trainer_id), None)
+            self._leases.pop(self._key(trainer_id), None)
 
     def snapshot(self) -> Dict[int, Dict]:
         now = time.monotonic()

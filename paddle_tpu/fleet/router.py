@@ -1,0 +1,710 @@
+"""fluid-fleet router: health-gated membership + least-loaded dispatch +
+coordinated hot swap over N replica InferenceServers.
+
+The TF system paper's serving story at fleet scale, built from parts
+this repo already trusts:
+
+- **Membership** is ark heartbeat leases (`ark.LeaseTable`): replicas
+  renew at a third of the lease; a SIGKILLed replica stops renewing and
+  drops out of dispatch within lease-time. A successful readiness poll
+  ALSO renews the lease (probe evidence of liveness), so statically
+  added replicas (tests, loadgen) need no replica-side heartbeat loop.
+- **Readiness** is the fluid-pulse `/readyz` contract: a poll thread
+  GETs each replica's pulse endpoint (HTTP) when one is advertised,
+  falling back to the replica's `readyz` RPC (identical body). A
+  replica takes traffic only when its verdict is ok AND the model's
+  active version is WARMED and matches the fleet's committed version —
+  "right version, warmed", not just "alive".
+- **Dispatch** is least-loaded: router-side in-flight count plus the
+  last-polled queue depth per replica; ties break round-robin.
+- **Failover** rides the ark retry idioms: a transport error reroutes
+  the (idempotent, read-only) request to the next-best replica and
+  marks the member suspect until a poll clears it; a RETRIABLE serve
+  error (queue full, cache exhausted, mid-load) sheds to another
+  replica; a TERMINAL error (bad request, unknown model) propagates
+  immediately — retrying a malformed request elsewhere helps no one.
+- **Coordinated hot swap** is two-phase and version-skew-free: every
+  ready replica stages+warms the new version (`prepare_swap`), the
+  router verifies all staged manifests are IDENTICAL bytes
+  (content-addressed `version_key`), briefly gates new dispatches,
+  drains its in-flight window, then flips every replica
+  (`commit_swap` — a pointer flip, milliseconds) and reopens. Any
+  prepare failure aborts fleet-wide and the old version keeps serving
+  everywhere. After a swap the committed `version_key` gates readiness,
+  so a replica that missed the flip (or a stale joiner) gets no traffic
+  until it catches up.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ..ark.liveness import LeaseTable
+from ..ark.retry import RetryPolicy
+from ..observe import metrics as _metrics
+from ..pserver import rpc as _rpc
+from ..serve.errors import (DeadlineExceededError, ModelUnavailableError,
+                            ServeError)
+from . import wire as _wire
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class RouterConfig:
+    control_endpoint: str = "127.0.0.1:0"   # replicas heartbeat here
+    lease_s: float = 3.0                    # membership lease duration
+    poll_interval_s: float = 0.5            # readiness poll cadence
+    poll: str = "auto"                      # "auto" | "http" | "rpc"
+    retry: Optional[RetryPolicy] = None     # failover budget per request
+    request_deadline_s: float = 30.0        # per-RPC transport deadline
+    swap_drain_timeout_s: float = 30.0      # in-flight drain bound
+    pool_max_idle: int = 8                  # idle sockets per replica
+    # fluid-pulse opt-in: the router's own health plane (requires the
+    # observe flag) with a fleet_membership readiness check
+    pulse_port: Optional[int] = None
+
+
+class FleetError(ServeError):
+    """A fleet-level operation (swap, membership) failed."""
+
+
+class FleetResult:
+    """One routed response: the fetches plus where/what served it.
+
+    `seq` is the router-assigned completion sequence number, taken
+    under the router lock BEFORE the request leaves the in-flight
+    window: ordering responses by `seq` is the authoritative wire-level
+    completion order (client-side timestamps can invert under thread
+    scheduling), so the skew gate — every old-version response precedes
+    every new-version one across a coordinated swap — is exact."""
+
+    __slots__ = ("outs", "tokens", "version", "version_key", "replica_id",
+                 "latency_us", "seq")
+
+    def __init__(self, outs=None, tokens=None, version=None,
+                 version_key=None, replica_id=None, latency_us=0.0,
+                 seq=0):
+        self.outs = outs
+        self.tokens = tokens
+        self.version = version
+        self.version_key = version_key
+        self.replica_id = replica_id
+        self.latency_us = latency_us
+        self.seq = seq
+
+
+class _Member:
+    def __init__(self, replica_id: str, endpoint: str,
+                 pulse_port: Optional[int], pool_max_idle: int):
+        self.replica_id = replica_id
+        self.endpoint = endpoint
+        self.pulse_port = pulse_port
+        self.pool = _wire.ConnPool(endpoint, max_idle=pool_max_idle)
+        self.session: Optional[str] = None
+        # readiness state, written by the poller (and by failover marks)
+        self.ready = False
+        self.models: Dict[str, dict] = {}
+        self.last_poll = 0.0
+        self.suspect = False     # transport error seen; poll must clear
+        self.inflight = 0        # router-side concurrent requests
+
+    def close(self):
+        self.pool.close()
+
+
+class FleetRouter(_wire.HardCutServer):
+    def __init__(self, config: Optional[RouterConfig] = None):
+        super().__init__()
+        self.config = config or RouterConfig()
+        self.retry = self.config.retry or RetryPolicy(
+            max_attempts=3, base_delay=0.01, max_delay=0.25)
+        self._lock = threading.RLock()
+        self._members: Dict[str, _Member] = {}
+        self._lease = LeaseTable()
+        self._rr = 0
+        # committed fleet version per model (set by swap); gates
+        # readiness so a stale replica can never serve mixed versions
+        self._desired: Dict[str, str] = {}
+        # swap gate per model: set() = dispatch open
+        self._gates: Dict[str, threading.Event] = {}
+        self._inflight: Dict[str, int] = {}
+        self._drain = threading.Condition(self._lock)
+        # completion sequence: assigned under the lock while the request
+        # is STILL in-flight, so swap's drain orders it before every
+        # post-reopen request — the skew gate's exact ordering source
+        self._completion_seq = 0
+        self.control_endpoint: Optional[str] = None
+        self._poller: Optional[threading.Thread] = None
+        self.pulse_port: Optional[int] = None
+        self._pulse_check_name: Optional[str] = None
+        # metrics (serve-style: always on — these are control-plane
+        # rates, not hot-path per-step writes)
+        self._m_requests = _metrics.counter(
+            "fleet_requests_total", "routed requests by model/outcome")
+        self._m_latency = _metrics.histogram(
+            "fleet_request_latency_us", "router-observed request latency")
+        self._m_failovers = _metrics.counter(
+            "fleet_failovers_total",
+            "requests rerouted after a replica transport failure")
+        self._m_sheds = _metrics.counter(
+            "fleet_sheds_total",
+            "requests rerouted off a backpressuring replica")
+        self._m_ready = _metrics.gauge(
+            "fleet_replicas_ready", "replicas passing the readiness gate")
+        self._m_members = _metrics.gauge(
+            "fleet_replicas_registered", "replicas holding a live lease")
+        self._m_swaps = _metrics.counter(
+            "fleet_swaps_total", "coordinated swaps by outcome")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "FleetRouter":
+        self.control_endpoint = self._bind_and_accept(
+            self.config.control_endpoint,
+            f"fleet-router@{self.config.control_endpoint}")
+        self._poller = threading.Thread(
+            target=self._poll_loop, daemon=True,
+            name=f"fleet-poll@{self.control_endpoint}")
+        self._poller.start()
+        if self.config.pulse_port is not None:
+            from ..observe import health as _health
+            from ..observe import pulse as _pulse
+            self.pulse_port = _pulse.start_pulse(self.config.pulse_port)
+            self._pulse_check_name = f"fleet_membership@{id(self):x}"
+            _health.get_engine().register_check(
+                self._pulse_check_name, self._pulse_membership_check,
+                ready=True)
+        logger.info("fleet router control endpoint %s",
+                    self.control_endpoint)
+        return self
+
+    def close(self):
+        if self._pulse_check_name is not None:
+            from ..observe import health as _health
+            _health.get_engine().unregister_check(self._pulse_check_name)
+            self._pulse_check_name = None
+        self._hard_cut()
+        if self._poller is not None:
+            self._poller.join(timeout=5)
+        with self._lock:
+            members = list(self._members.values())
+            self._members.clear()
+        for m in members:
+            m.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- membership --------------------------------------------------------
+
+    def add_replica(self, endpoint: str, replica_id: Optional[str] = None,
+                    pulse_port: Optional[int] = None) -> str:
+        """Static registration (loadgen/tests/ops): the replica joins
+        with a fresh lease; the poller keeps the lease alive while the
+        replica answers readiness probes. Heartbeating replicas register
+        themselves through the control endpoint instead."""
+        rid = replica_id or f"r@{endpoint}"
+        self._register(rid, endpoint, pulse_port, session=None,
+                       lease_s=self.config.lease_s)
+        self._poll_member_now(rid)
+        return rid
+
+    def _register(self, replica_id, endpoint, pulse_port, session,
+                  lease_s):
+        with self._lock:
+            m = self._members.get(replica_id)
+            if m is None or m.endpoint != endpoint:
+                if m is not None:
+                    m.close()
+                m = _Member(replica_id, endpoint, pulse_port,
+                            self.config.pool_max_idle)
+                self._members[replica_id] = m
+            if pulse_port is not None:
+                m.pulse_port = pulse_port
+            if session is not None and m.session != session:
+                # a RESTARTED replica process re-registered under the
+                # same id: clear the suspect mark, force a fresh poll
+                m.session = session
+                m.suspect = True
+        self._lease.beat(replica_id, session=session, lease_s=lease_s)
+
+    def remove_replica(self, replica_id: str) -> bool:
+        with self._lock:
+            m = self._members.pop(replica_id, None)
+        self._lease.forget(replica_id)
+        if m is not None:
+            m.close()
+            return True
+        return False
+
+    def members(self) -> Dict[str, dict]:
+        live = set(self._lease.live())
+        with self._lock:
+            return {rid: {
+                "endpoint": m.endpoint,
+                "lease_live": rid in live,
+                "ready": m.ready and not m.suspect,
+                "suspect": m.suspect,
+                "inflight": m.inflight,
+                "models": dict(m.models),
+                "pulse_port": m.pulse_port,
+            } for rid, m in self._members.items()}
+
+    def _live_members(self) -> List[_Member]:
+        live = set(self._lease.live())
+        with self._lock:
+            return [m for rid, m in self._members.items() if rid in live]
+
+    def ready_members(self, model: str) -> List[_Member]:
+        """Members allowed to take `model` traffic: live lease, ready
+        verdict, not suspect, model present+warmed, and — once a swap
+        committed a fleet version — the matching version_key."""
+        want = self._desired.get(model)
+        out = []
+        for m in self._live_members():
+            if not m.ready or m.suspect:
+                continue
+            d = m.models.get(model)
+            if not d or not d.get("warmed"):
+                continue
+            if want is not None and d.get("version_key") != want:
+                continue
+            out.append(m)
+        return out
+
+    # -- readiness polling -------------------------------------------------
+
+    def _poll_loop(self):
+        while not self._stop.wait(self.config.poll_interval_s):
+            for m in list(self._members.values()):
+                if self._stop.is_set():
+                    return
+                self._poll_member(m)
+            ready_by_model: Dict[str, int] = {}
+            with self._lock:
+                models = {name for m in self._members.values()
+                          for name in m.models}
+            for name in models:
+                ready_by_model[name] = len(self.ready_members(name))
+                self._m_ready.set(ready_by_model[name], model=name)
+            self._m_members.set(len(self._live_members()))
+
+    def _poll_member_now(self, replica_id: str):
+        with self._lock:
+            m = self._members.get(replica_id)
+        if m is not None:
+            self._poll_member(m)
+
+    def _poll_member(self, m: _Member):
+        doc = None
+        try:
+            if m.pulse_port and self.config.poll in ("auto", "http"):
+                doc = self._poll_http(m)
+            else:
+                doc = _wire.call(m.pool, "readyz", {}, deadline_s=2.0)
+        except Exception as e:
+            logger.debug("fleet poll of %s failed: %r", m.replica_id, e)
+            with self._lock:
+                m.ready = False
+                m.last_poll = time.monotonic()
+            return
+        with self._lock:
+            m.ready = doc.get("status") == "ok"
+            m.models = dict(doc.get("models") or {})
+            m.suspect = False
+            m.last_poll = time.monotonic()
+        # probe evidence of liveness: a poll that answered renews the
+        # lease (static members have no heartbeat loop of their own)
+        self._lease.beat(m.replica_id, session=m.session,
+                         lease_s=self.config.lease_s)
+
+    def _poll_http(self, m: _Member) -> dict:
+        """The fluid-pulse /readyz HTTP contract: 200/503 with a verdict
+        body whose serve_queues check detail carries the per-model
+        version/warmed/depth facts (serve.InferenceServer.model_detail).
+        503 still parses — unready is a verdict, not a transport error."""
+        import json
+        import urllib.error
+        import urllib.request
+
+        url = f"http://127.0.0.1:{m.pulse_port}/readyz"
+        host = m.endpoint.split(":")[0]
+        if host not in ("127.0.0.1", "localhost", "0.0.0.0"):
+            url = f"http://{host}:{m.pulse_port}/readyz"
+        try:
+            with urllib.request.urlopen(url, timeout=2.0) as r:
+                doc = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            doc = json.loads(e.read())
+        models: Dict[str, dict] = {}
+        for name, check in (doc.get("checks") or {}).items():
+            if name.startswith("serve_queues"):
+                models.update(check.get("detail") or {})
+        return {"status": doc.get("status"), "models": models}
+
+    # -- control endpoint (replica heartbeats) -----------------------------
+    # accept/teardown plumbing: wire.HardCutServer
+
+    def _serve_conn(self, conn):
+        while not self._stop.is_set():
+            try:
+                msg = _rpc.recv_msg(conn)
+            except (ConnectionError, EOFError, OSError):
+                return
+            if self._stop.is_set():
+                return
+            try:
+                cmd, payload = msg[0], msg[1]
+            except (TypeError, IndexError):
+                _rpc.send_msg(conn, ("err", "MalformedFrame"))
+                continue
+            try:
+                reply = self._control_dispatch(cmd, payload)
+            except Exception as e:
+                reply = ("err", f"{type(e).__name__}: {e}")
+            try:
+                _rpc.send_msg(conn, reply)
+            except (ConnectionError, OSError):
+                return
+
+    def _control_dispatch(self, cmd, p):
+        if cmd == "replica_heartbeat":
+            self._register(p["replica_id"], p["endpoint"],
+                           p.get("pulse_port"), p.get("session"),
+                           float(p.get("lease_s") or self.config.lease_s))
+            return ("ok", {"members": len(self._members)})
+        if cmd == "replica_leave":
+            return ("ok", {"removed":
+                           self.remove_replica(p["replica_id"])})
+        if cmd == "router_stats":
+            return ("ok", self.stats())
+        if cmd == "ping":
+            return ("ok", {"control": self.control_endpoint})
+        raise ValueError(f"unknown fleet router command {cmd!r}")
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _gate(self, model: str) -> threading.Event:
+        with self._lock:
+            g = self._gates.get(model)
+            if g is None:
+                g = self._gates[model] = threading.Event()
+                g.set()
+            return g
+
+    def _pick(self, model: str, exclude: set) -> Optional[_Member]:
+        """Least-loaded among ready members: router in-flight plus the
+        last-polled queue depth; round-robin among ties."""
+        cands = [m for m in self.ready_members(model)
+                 if m.replica_id not in exclude]
+        if not cands:
+            return None
+        with self._lock:
+            def score(m: _Member):
+                depth = (m.models.get(model) or {}).get("depth") or 0
+                return m.inflight + depth
+            best = min(score(m) for m in cands)
+            tied = [m for m in cands if score(m) == best]
+            self._rr += 1
+            return tied[self._rr % len(tied)]
+
+    def _request(self, model: str, cmd: str, payload: dict) -> FleetResult:
+        """The routed request core: gate, pick, call, classify, retry."""
+        payload = {"model": model, **payload}
+        gate_deadline = time.monotonic() + \
+            self.config.swap_drain_timeout_s + 5.0
+        while True:
+            gate = self._gate(model)
+            if not gate.wait(timeout=max(
+                    0.01, gate_deadline - time.monotonic())):
+                raise ModelUnavailableError(
+                    f"model {model!r}: dispatch gated by a coordinated "
+                    f"swap that never completed")
+            with self._lock:
+                # re-check UNDER THE LOCK: a swap's gate.clear() racing
+                # the bare wait() would otherwise let this request slip
+                # in unregistered — invisible to the swap's drain, free
+                # to execute on an unflipped replica mid-flip (exactly
+                # the mixed-version window the drain exists to close)
+                if gate.is_set():
+                    self._inflight[model] = \
+                        self._inflight.get(model, 0) + 1
+                    break
+            if time.monotonic() >= gate_deadline:
+                raise ModelUnavailableError(
+                    f"model {model!r}: dispatch gated by a coordinated "
+                    f"swap that never completed")
+        t0 = time.perf_counter()
+        exclude: set = set()
+        attempt = 0
+        last_err: Optional[BaseException] = None
+        try:
+            while True:
+                m = self._pick(model, exclude)
+                if m is None and not exclude and \
+                        attempt <= self.retry.max_attempts:
+                    # nobody ready RIGHT NOW but nothing failed either
+                    # (a swap just reopened, a poll is in flight, a
+                    # replica is joining): wait a poll beat inside the
+                    # retry budget instead of bouncing the request
+                    attempt += 1
+                    time.sleep(min(self.config.poll_interval_s, 0.25))
+                    continue
+                if m is None:
+                    self._m_requests.inc(model=model, outcome="no_replica")
+                    if last_err is not None:
+                        raise last_err
+                    raise ModelUnavailableError(
+                        f"model {model!r}: no ready replica "
+                        f"(members: {sorted(self._members)})")
+                with self._lock:
+                    m.inflight += 1
+                try:
+                    value = _wire.call(
+                        m.pool, cmd, payload,
+                        deadline_s=self.config.request_deadline_s)
+                    dt_us = (time.perf_counter() - t0) * 1e6
+                    with self._lock:
+                        self._completion_seq += 1
+                        seq = self._completion_seq
+                    self._m_requests.inc(model=model, outcome="ok")
+                    self._m_latency.observe(dt_us, model=model)
+                    return FleetResult(
+                        outs=value.get("outs"),
+                        tokens=value.get("tokens"),
+                        version=value.get("version"),
+                        version_key=value.get("version_key"),
+                        replica_id=value.get("replica_id", m.replica_id),
+                        latency_us=dt_us, seq=seq)
+                except (ConnectionError, EOFError, OSError) as e:
+                    # transport death: the replica is gone or mid-kill.
+                    # infer/generate are read-only and idempotent, so a
+                    # recv-phase failure is safe to replay on a peer
+                    # (the PSClient read-failover rule).
+                    last_err = e
+                    exclude.add(m.replica_id)
+                    with self._lock:
+                        m.suspect = True   # a fresh poll must clear it
+                    self._m_failovers.inc(model=model, frm=m.replica_id)
+                    logger.warning(
+                        "fleet: %s failed %s (%r) — failing over",
+                        m.replica_id, cmd, e)
+                except ServeError as e:
+                    if not getattr(e, "retriable", False) or \
+                            isinstance(e, DeadlineExceededError):
+                        # terminal (bad request, unknown model) — or a
+                        # deadline that already burned the caller's
+                        # budget: rerouting cannot help
+                        self._m_requests.inc(model=model,
+                                             outcome="terminal_error")
+                        raise
+                    # retriable backpressure: shed to another replica
+                    last_err = e
+                    exclude.add(m.replica_id)
+                    self._m_sheds.inc(model=model, frm=m.replica_id,
+                                      reason=type(e).__name__)
+                finally:
+                    with self._lock:
+                        m.inflight -= 1
+                attempt += 1
+                if attempt > self.retry.max_attempts:
+                    self._m_requests.inc(model=model, outcome="exhausted")
+                    raise last_err
+                delay = self.retry.backoff(attempt - 1)
+                if delay and not self.ready_members(model):
+                    time.sleep(min(delay, 0.25))
+        finally:
+            with self._lock:
+                self._inflight[model] -= 1
+                self._drain.notify_all()
+
+    def infer(self, model: str, feed: dict,
+              deadline_ms: Optional[float] = None) -> FleetResult:
+        """Route one one-shot inference request; returns a FleetResult
+        whose .outs is the fetch list and .version/.version_key name the
+        version that EXECUTED it (the skew gate's evidence)."""
+        return self._request(model, "infer",
+                             {"feed": feed, "deadline_ms": deadline_ms})
+
+    def generate(self, model: str, prompt, max_new_tokens: int = 16,
+                 deadline_ms: Optional[float] = None) -> FleetResult:
+        """Route one generation; in-flight generations stay pinned to
+        their version per replica (the decode engine's guarantee)."""
+        return self._request(
+            model, "generate",
+            {"prompt": prompt, "max_new_tokens": max_new_tokens,
+             "deadline_ms": deadline_ms})
+
+    # -- coordinated hot swap ---------------------------------------------
+
+    def swap(self, model: str, dirname: Optional[str] = None) -> dict:
+        """Version-skew-free fleet swap (see module docstring). Returns
+        a report dict; raises FleetError (old version keeps serving
+        everywhere) on any prepare/verify failure."""
+        t0 = time.perf_counter()
+        targets = self.ready_members(model)
+        if not targets:
+            self._m_swaps.inc(model=model, outcome="no_replica")
+            raise FleetError(
+                f"swap({model!r}): no ready replica to swap")
+
+        # phase 1: stage + warm EVERYWHERE (parallel; slowest replica
+        # bounds the phase, traffic keeps flowing on the old version)
+        staged: Dict[str, dict] = {}
+        errors: Dict[str, str] = {}
+
+        def _prepare(m: _Member):
+            try:
+                staged[m.replica_id] = _wire.call(
+                    m.pool, "prepare_swap",
+                    {"model": model, "dirname": dirname},
+                    deadline_s=max(self.config.request_deadline_s, 120.0))
+            except Exception as e:
+                errors[m.replica_id] = f"{type(e).__name__}: {e}"
+
+        threads = [threading.Thread(target=_prepare, args=(m,))
+                   for m in targets]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        keys = {d.get("version_key") for d in staged.values()}
+        if errors or len(keys) != 1 or None in keys:
+            for m in targets:
+                if m.replica_id in staged:
+                    try:
+                        _wire.call(m.pool, "abort_swap", {"model": model},
+                                   deadline_s=10.0)
+                    except Exception:
+                        pass
+            self._m_swaps.inc(model=model, outcome="prepare_failed")
+            raise FleetError(
+                f"swap({model!r}) aborted — old version keeps serving: "
+                f"prepare errors {errors or 'none'}, staged keys "
+                f"{sorted(k for k in keys if k)}"
+                + (" (replicas staged DIFFERENT content)"
+                   if len(keys) > 1 else ""))
+        new_key = keys.pop()
+
+        # phase 2: gate new dispatches and drain the router's in-flight
+        # window — responses already executing finish on the OLD version
+        # BEFORE any replica flips, so no client can observe new-then-old
+        gate = self._gate(model)
+        gate.clear()
+        committed: Dict[str, dict] = {}
+        try:
+            deadline = time.monotonic() + self.config.swap_drain_timeout_s
+            with self._lock:
+                while self._inflight.get(model, 0) > 0:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        self._m_swaps.inc(model=model,
+                                          outcome="drain_timeout")
+                        raise FleetError(
+                            f"swap({model!r}): {self._inflight[model]} "
+                            f"requests failed to drain in "
+                            f"{self.config.swap_drain_timeout_s}s — "
+                            f"aborted, old version keeps serving")
+                    self._drain.wait(remaining)
+
+            # phase 3: flip everywhere (pure pointer flips — staged
+            # versions are already warmed)
+            flip_errors: Dict[str, str] = {}
+            for m in targets:
+                try:
+                    committed[m.replica_id] = _wire.call(
+                        m.pool, "commit_swap", {"model": model},
+                        deadline_s=30.0)
+                except Exception as e:
+                    flip_errors[m.replica_id] = f"{type(e).__name__}: {e}"
+                    with self._lock:
+                        m.suspect = True
+            if not committed:
+                self._m_swaps.inc(model=model, outcome="commit_failed")
+                raise FleetError(
+                    f"swap({model!r}): every commit failed "
+                    f"({flip_errors}) — fleet stays on the old version")
+            # partial success: best-effort abort on the replicas whose
+            # flip failed, or their staged (fully loaded + warmed)
+            # version would sit in memory indefinitely; if the commit
+            # actually landed and only the reply died, the abort is a
+            # no-op and the replica rejoins on the new version_key
+            for m in targets:
+                if m.replica_id in staged and \
+                        m.replica_id not in committed:
+                    try:
+                        _wire.call(m.pool, "abort_swap", {"model": model},
+                                   deadline_s=10.0)
+                    except Exception:
+                        pass
+            # refresh membership detail BEFORE the gate reopens, so the
+            # first gated-out request dispatches on the new version_key
+            # instead of finding a momentarily-empty ready set
+            for m in targets:
+                if m.replica_id in committed:
+                    self._poll_member(m)
+            # the fleet version is now new_key: any replica that failed
+            # its flip reports a stale version_key and the readiness
+            # gate keeps it out of dispatch until it catches up
+            self._desired[model] = new_key
+        except FleetError:
+            for m in targets:
+                if m.replica_id not in committed:
+                    try:
+                        _wire.call(m.pool, "abort_swap", {"model": model},
+                                   deadline_s=10.0)
+                    except Exception:
+                        pass
+            raise
+        finally:
+            gate.set()
+        self._m_swaps.inc(model=model, outcome="ok")
+        report = {
+            "model": model,
+            "version_key": new_key,
+            "replicas": sorted(committed),
+            "failed_commits": sorted(set(staged) - set(committed)),
+            "wall_s": round(time.perf_counter() - t0, 3),
+        }
+        logger.info("fleet: coordinated swap of %r -> %s across %d "
+                    "replicas in %.2fs", model, new_key[:12],
+                    len(committed), report["wall_s"])
+        return report
+
+    # -- introspection -----------------------------------------------------
+
+    def _pulse_membership_check(self):
+        """fluid-pulse check: every model the fleet serves must have at
+        least one ready replica."""
+        members = self.members()
+        models: Dict[str, int] = {}
+        for m in members.values():
+            for name in m["models"]:
+                models.setdefault(name, 0)
+        for name in models:
+            models[name] = len(self.ready_members(name))
+        ok = all(n > 0 for n in models.values()) if models else True
+        return ok, {"ready_by_model": models,
+                    "members": {rid: {"ready": m["ready"],
+                                      "endpoint": m["endpoint"]}
+                                for rid, m in members.items()},
+                    "desired_versions": dict(self._desired)}
+
+    def stats(self) -> dict:
+        with self._lock:
+            inflight = dict(self._inflight)
+        return {
+            "control_endpoint": self.control_endpoint,
+            "members": self.members(),
+            "inflight": inflight,
+            "desired_versions": dict(self._desired),
+            "ts": time.time(),
+        }

@@ -143,7 +143,8 @@ def load_persistables(executor, dirname, main_program=None, filename=None,
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
                          params_filename=None, scope=None,
-                         extra_programs=None, manifest_extra=None):
+                         extra_programs=None, manifest_extra=None,
+                         exclude_vars=None):
     """Prune to the inference slice and persist program+params
     (reference io.py:551).
 
@@ -155,6 +156,14 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     block size, max context, cache var names) lives there so a registry
     load can size the KV cache and warm-compile the decode program
     without a probe request; loaders of legacy manifests see neither key.
+
+    `exclude_vars` (a set of names) skips persistables whose VALUES must
+    not land in the dir — fluid-fleet's distributed lookup tables, whose
+    rows live only in pserver shards and are pulled at serve time
+    (`fleet.sparse`). The program keeps the var (the lookup op needs its
+    declared shape); a loader must feed or skip it — the manifest's
+    `sparse` key (written by `fleet.sparse.save_sparse_inference_model`)
+    tells `serve.ModelRegistry` which.
 
     ark crash safety: the whole model dir is STAGED in a same-parent tmp
     dir and swapped in at the end — program json and params commit as one
@@ -205,7 +214,17 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         for extra_name, extra_meta in (extra_programs or {}).items():
             with open(os.path.join(stage, extra_name), "w") as f:
                 json.dump(extra_meta, f)
-        save_persistables(executor, stage, pruned, params_filename, scope)
+        excl = set(exclude_vars or ())
+        if excl:
+            save_vars(executor, stage, pruned,
+                      predicate=lambda v: _is_persistable(v)
+                      and v.name not in excl,
+                      filename=params_filename, scope=scope)
+        else:
+            # the plain path keeps going through save_persistables — a
+            # monkeypatchable seam crash-injection tests rely on
+            save_persistables(executor, stage, pruned, params_filename,
+                              scope)
         # integrity manifest, written LAST inside the stage: a sha256 per
         # payload file, so load_inference_model (and ark's
         # verify_checkpoint) can refuse a bit-rotted dir instead of
@@ -267,7 +286,8 @@ def verify_inference_model(dirname) -> Optional[dict]:
 
 
 def load_inference_model(dirname, executor, model_filename=None,
-                         params_filename=None, scope=None, verify=True):
+                         params_filename=None, scope=None, verify=True,
+                         skip_vars=None):
     """reference io.py:654 — returns (program, feed_names, fetch_vars).
 
     `verify=True` (default) checks the whole dir against the sha256
@@ -275,14 +295,23 @@ def load_inference_model(dirname, executor, model_filename=None,
     deserializing anything: a bit-rotted or torn dir raises
     ModelIntegrityError naming the corrupt file instead of half-loading
     (program json parsed, some params garbage). Legacy dirs without a
-    manifest load unverified."""
+    manifest load unverified.
+
+    `skip_vars` names persistables the dir deliberately does NOT carry
+    (saved with `exclude_vars=` — distributed lookup tables whose rows
+    stay in pserver shards); they are neither loaded nor required, and
+    the caller must feed them at run time."""
     if verify:
         verify_inference_model(dirname)
     with open(os.path.join(dirname, model_filename or MODEL_FILENAME)) as f:
         meta = json.load(f)
     program = ir.Program.from_dict(meta["program"])
     program._is_inference = True
-    load_persistables(executor, dirname, program, params_filename, scope)
+    skip = set(skip_vars or ())
+    load_vars(executor, dirname, program,
+              vars=[v for v in _collect(program, _is_persistable)
+                    if v.name not in skip],
+              filename=params_filename, scope=scope)
     fetch_vars = [program.global_block().var(n) for n in meta["fetch_names"]]
     return program, meta["feed_names"], fetch_vars
 

@@ -29,6 +29,8 @@ paper's per-task health story. Three pieces:
   * ``serve_deadline_miss``  deadline rejections above a windowed rate
   * ``ps_retry_storm``       client RPC retries above a windowed rate
   * ``lease_churn``          evictions+readmissions above a windowed rate
+  * ``fleet_failover_storm`` router request failovers above a windowed
+                             rate — replica membership is flapping
   * ``wire_compression_collapse`` on-wire ratio fell to half of the
                              session's established ratio
 
@@ -496,6 +498,9 @@ DEFAULT_WATCHES = (
     ("serve_rejects_total", "serve_deadline_miss", {"reason": "deadline"}),
     ("pserver_wire_bytes_raw", "wire_raw_bytes", None),
     ("pserver_wire_bytes_encoded", "wire_encoded_bytes", None),
+    # fluid-fleet: router-side failovers (a replica answered a request
+    # another replica dropped) — a storm means replicas are flapping
+    ("fleet_failovers_total", "fleet_failovers", None),
 )
 
 
@@ -609,6 +614,13 @@ class HealthEngine:
                                       window_s=60.0, threshold=3.0),
                     RateSpikeDetector("serve_deadline_miss",
                                       "serve_deadline_miss",
+                                      window_s=15.0, threshold=8.0),
+                    # fluid-fleet: sustained request rerouting — one
+                    # failover per dead replica is the design working;
+                    # a windowed burst means membership is flapping or a
+                    # replica is half-dead (accepting then dropping)
+                    RateSpikeDetector("fleet_failover_storm",
+                                      "fleet_failovers",
                                       window_s=15.0, threshold=8.0),
                     CompressionCollapseDetector()):
             self.add_detector(det)

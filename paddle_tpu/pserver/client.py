@@ -62,7 +62,15 @@ class PSClient:
                  deadline: Optional[float] = None,
                  replicas: Optional[Dict[str, Sequence[str]]] = None,
                  wire_trace: bool = True,
-                 comm_quant: Optional[str] = None):
+                 comm_quant: Optional[str] = None,
+                 read_only: bool = False):
+        # fluid-fleet: a serving replica's sparse read path holds a
+        # PSClient purely to PULL rows — read_only=True makes a mutating
+        # call (a stray push_grad from a serving process would corrupt
+        # live training state) unrepresentable rather than a code-review
+        # promise. wire_caps stays allowed: negotiation is how the pull
+        # path gets its codec.
+        self.read_only = bool(read_only)
         # fluid-xray: with `wire_trace` (and the `observe` flag on) each
         # request frame carries a traceparent meta element so the server's
         # handler span joins this client's trace. False restores the bare
@@ -174,9 +182,19 @@ class PSClient:
     # deadline applies
     _NO_DEFAULT_DEADLINE = frozenset({"sync_apply", "batch_barrier"})
 
+    # commands a read_only client may issue: the read set plus the
+    # negotiation/introspection commands that mutate nothing server-side
+    _READ_ONLY_ALLOWED = frozenset({"get_param", "get_params", "prefetch",
+                                    "stats", "wire_caps"})
+
     def _call(self, endpoint, cmd, _deadline=..., **payload):
         """One RPC with retry/backoff/deadline; `_deadline=...` (unset)
         follows the client default, None disables, a float overrides."""
+        if self.read_only and cmd not in self._READ_ONLY_ALLOWED:
+            raise RuntimeError(
+                f"PSClient(read_only=True) refuses mutating command "
+                f"{cmd!r} — the serve-time sparse read path may only "
+                f"{sorted(self._READ_ONLY_ALLOWED)}")
         if _deadline is ...:
             _deadline = (None if cmd in self._NO_DEFAULT_DEADLINE
                          else self.deadline)

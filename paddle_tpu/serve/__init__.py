@@ -35,5 +35,6 @@ from .errors import (BadRequestError, CacheExhaustedError,  # noqa: F401
                      ModelUnavailableError, QueueFullError, ServeError)
 from .kvcache import PagedKVCache  # noqa: F401
 from .registry import (DecodeModel, ModelRegistry,  # noqa: F401
-                       ModelVersion, read_decode_signature)
+                       ModelVersion, read_decode_signature,
+                       read_model_manifest)
 from .server import InferenceServer, ServeConfig  # noqa: F401

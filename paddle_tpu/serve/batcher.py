@@ -408,6 +408,12 @@ class MicroBatcher:
             feeds, rows = concat_requests([r.planned for r in batch])
             target = ver.ladder.rows_rung(rows)
             padded = pad_rows(feeds, rows, target)
+            if ver.sparse_plan is not None:
+                # fluid-fleet: pull this BATCH's unique embedding rows
+                # from the pserver shards (row-cache first) and feed them
+                # as fixed-shape sub-tables with ids remapped — after
+                # padding, so the fed shapes match the warmed signature
+                padded = ver.sparse_plan.augment(padded)
             # fluid-xray batch span: the ONE prepared step serving these
             # coalesced requests. Parented to the oldest request's trace
             # (the one that waited longest for this batch); the other
@@ -421,6 +427,13 @@ class MicroBatcher:
             t0 = time.perf_counter()
             fetches = ver.prepared.run(padded)
             dt = time.perf_counter() - t0
+            # a version loaded with warm=False becomes "warmed" by
+            # serving (it compiled on demand): /readyz must not report a
+            # once-cold-but-now-serving standalone deployment unready
+            # forever. Fleet routers still never dispatch to a replica
+            # before its first ready verdict, so the AOT-warm contract
+            # ("no compiles on routed traffic") holds where it matters.
+            ver.warmed = True
             if bctx is not None:
                 _xray.record_span(
                     "serve_batch", bctx, ts_wall, dt, cat="serve",
@@ -450,6 +463,12 @@ class MicroBatcher:
                 self._req_span(
                     r, "ok",
                     **({"batch_span": bctx.span_id} if bctx else {}))
+                # fluid-fleet: tag the resolving Future with the version
+                # that actually EXECUTED this request — the replica RPC
+                # layer returns it so the router's skew gate can prove a
+                # coordinated swap produced no mixed-version responses
+                r.future.version_id = ver.version_id
+                r.future.version_key = ver.version_key
                 r.future.set_result(outs)
         except Exception as e:
             for r in batch:

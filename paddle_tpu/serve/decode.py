@@ -431,6 +431,11 @@ class DecodeEngine:
             "tokens": tokens, "block_tables": bt, "seq_lens": seq_lens})
         self._m_prefill_latency.observe(
             (time.perf_counter() - t0) * 1e6, model=self._name)
+        # a warm=False generative version becomes "warmed" by serving
+        # (same /readyz contract as the MicroBatcher one-shot path —
+        # without this, a cold-loaded generative server reports unready
+        # forever while generating fine)
+        self._ver.warmed = True
         done = time.monotonic()
         for r, (slot, state) in enumerate(members):
             tok = int(np.argmax(logits[r]))

@@ -73,17 +73,24 @@ class DecodeModel:
         self.kvcache = kvcache
 
 
+def read_model_manifest(dirname: str) -> dict:
+    """The model dir's MANIFEST.json as a dict ({} for legacy dirs or an
+    unreadable manifest — verify=True inside the load names the problem
+    loudly; this read only routes load-time decisions)."""
+    path = os.path.join(dirname, _io.MODEL_MANIFEST)
+    if not os.path.isfile(path):
+        return {}
+    try:
+        with open(path) as f:
+            return json.load(f) or {}
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
 def read_decode_signature(dirname: str) -> Optional[dict]:
     """The MANIFEST's `decode` key, or None for one-shot (legacy) model
     dirs — those load exactly as before."""
-    path = os.path.join(dirname, _io.MODEL_MANIFEST)
-    if not os.path.isfile(path):
-        return None
-    try:
-        with open(path) as f:
-            return json.load(f).get("decode")
-    except (OSError, json.JSONDecodeError):
-        return None   # verify=True inside the load will name the problem
+    return read_model_manifest(dirname).get("decode")
 
 
 def ladder_from_signature(sig: dict) -> BucketLadder:
@@ -111,6 +118,19 @@ class ModelVersion:
         self.spec = spec
         self.loaded_at = time.time()
         self.decode: Optional[DecodeModel] = None
+        # fluid-fleet: content-addressed identity (sha256 of the dir's
+        # MANIFEST.json, which itself names every payload file's sha) —
+        # stable across replicas/hosts loading the same push, unlike the
+        # inode-based fingerprint; None for legacy manifest-less dirs
+        self.manifest_sha: Optional[str] = None
+        # fluid-fleet: the serve-time distributed sparse read path (a
+        # fleet.sparse.SparseLookupPlan) — feeds prefetched pserver rows
+        # under the table names per batch; owns the version-keyed row
+        # cache, so a hot swap naturally invalidates by retirement
+        self.sparse_plan = None
+        # readiness detail for the router's "right version, WARMED" gate:
+        # False until every ladder bucket (and the decode step) compiled
+        self.warmed = False
         self._refs = 0
         self._retired = False
         self._fully_retired = threading.Event()
@@ -122,6 +142,13 @@ class ModelVersion:
     @property
     def version_id(self) -> str:
         return f"{self.fingerprint[0]}:{self.fingerprint[1]}"
+
+    @property
+    def version_key(self) -> str:
+        """The cross-replica identity: manifest sha when the dir has one
+        (content-addressed — two replicas that loaded the same push agree
+        on it), else the local fingerprint."""
+        return self.manifest_sha or self.version_id
 
     def retired(self) -> bool:
         return self._fully_retired.is_set()
@@ -139,6 +166,13 @@ class _Slot:
         self.dirname = dirname
         self.ladder = ladder
         self.current: Optional[ModelVersion] = None
+        # fluid-fleet coordinated swap: a fully loaded+verified+warmed
+        # version staged by prepare() and published only by commit()
+        self.staged: Optional[ModelVersion] = None
+        # fluid-fleet sparse read path config (duck-typed factory with
+        # .build(sparse_meta, version) -> SparseLookupPlan); sticky per
+        # slot so the watcher's reloads keep the same wiring
+        self.sparse = None
 
 
 class ModelRegistry:
@@ -152,18 +186,16 @@ class ModelRegistry:
 
     # -- loading / swapping ----------------------------------------------
 
-    def load(self, name: str, dirname: str,
-             ladder: Optional[BucketLadder] = None,
-             warm: bool = True) -> ModelVersion:
-        """Load (first call) or hot-swap (subsequent calls) `name` from
-        `dirname`. Blocks until the new version is verified, loaded and
-        warmed; only then does the published pointer flip."""
+    def _slot_for_load(self, name, dirname, ladder, sparse):
+        """Resolve (and update) the slot + the manifest-driven load plan
+        shared by load() and prepare()."""
         dirname = os.path.abspath(dirname)
         # ONE manifest read per load: the ladder below and the cache
         # sizing in _load_version must come from the same signature (two
         # reads would race a concurrent atomic dir swap into a version
         # whose ladder disagrees with its warmed buckets)
-        sig = read_decode_signature(dirname)
+        manifest = read_model_manifest(dirname)
+        sig = manifest.get("decode")
         if ladder is None and sig is not None:
             # generative dir + no explicit ladder: the MANIFEST's decode
             # signature names the prefill rows/length rungs — a registry
@@ -178,7 +210,25 @@ class ModelRegistry:
                 slot.dirname = dirname
                 if ladder is not None:
                     slot.ladder = ladder
-        ver = self._load_version(name, dirname, slot.ladder, warm, sig)
+            if sparse is not None:
+                slot.sparse = sparse
+        return slot, dirname, manifest
+
+    def load(self, name: str, dirname: str,
+             ladder: Optional[BucketLadder] = None,
+             warm: bool = True, sparse=None) -> ModelVersion:
+        """Load (first call) or hot-swap (subsequent calls) `name` from
+        `dirname`. Blocks until the new version is verified, loaded and
+        warmed; only then does the published pointer flip. `sparse` wires
+        the fleet serve-time sparse read path (see _Slot.sparse)."""
+        slot, dirname, manifest = self._slot_for_load(
+            name, dirname, ladder, sparse)
+        ver = self._load_version(name, dirname, slot.ladder, warm,
+                                 manifest, slot.sparse)
+        self._publish(name, slot, ver)
+        return ver
+
+    def _publish(self, name: str, slot: _Slot, ver: ModelVersion):
         with self._lock:
             old, slot.current = slot.current, ver
             if old is not None:
@@ -192,18 +242,120 @@ class ModelRegistry:
             logger.info("serve: hot-swapped model %r -> version %s "
                         "(old drains %d in-flight)", name, ver.version_id,
                         old._refs)
+
+    # -- fleet coordinated swap: stage now, flip later ---------------------
+
+    def prepare(self, name: str, dirname: Optional[str] = None,
+                warm: bool = True) -> ModelVersion:
+        """Stage a new version of `name` WITHOUT publishing it: verify,
+        load and warm exactly like load(), but park the result so a later
+        commit() is a pure pointer flip. The fleet router uses this to
+        make the cross-replica flip window milliseconds wide (every
+        replica pays its load+warm before ANY replica flips). Re-staging
+        replaces (and releases) a previously staged version.
+
+        The slot's published config (dirname, ladder, sparse wiring) is
+        NOT touched until commit(): a dir watcher ticking between
+        prepare and commit must keep fingerprinting the PUBLISHED dir —
+        were slot.dirname moved early, the watcher would unilaterally
+        publish the staged (or fleet-ABORTED) version and break the
+        coordinated swap's whole point. `name` must already be loaded.
+
+        The staged version's ladder follows the same rule as load():
+        a generative dir's NEW decode signature re-derives the prefill
+        ladder (the pushed model's rungs, not the old version's — the
+        zero-recompile warm contract must hold for the NEW shape set);
+        one-shot dirs keep the slot's configured ladder."""
+        slot = self._slot(name)
+        dirname = os.path.abspath(dirname) if dirname is not None \
+            else slot.dirname
+        manifest = read_model_manifest(dirname)
+        sig = manifest.get("decode")
+        ladder = ladder_from_signature(sig) if sig is not None \
+            else slot.ladder
+        ver = self._load_version(name, dirname, ladder, warm,
+                                 manifest, slot.sparse)
+        with self._lock:
+            prev, slot.staged = slot.staged, ver
+        if prev is not None:
+            self._discard_staged(prev)
         return ver
 
+    def commit(self, name: str) -> ModelVersion:
+        """Publish the staged version (prepare() must have run): the
+        atomic pointer flip of the coordinated swap protocol. Only now
+        does the slot adopt the staged version's dir and ladder as its
+        published config (so the watcher resumes fingerprinting — and
+        later reloads re-warm — the right thing)."""
+        slot = self._slot(name)
+        with self._lock:
+            ver, slot.staged = slot.staged, None
+            if ver is not None:
+                slot.dirname = ver.dirname
+                slot.ladder = ver.ladder
+        if ver is None:
+            raise ModelUnavailableError(
+                f"model {name!r}: no staged version to commit — call "
+                f"prepare() first")
+        self._publish(name, slot, ver)
+        return ver
+
+    def abort(self, name: str) -> bool:
+        """Discard the staged version (a fleet-wide prepare failed on a
+        peer replica; the published version keeps serving untouched)."""
+        slot = self._slot(name)
+        with self._lock:
+            ver, slot.staged = slot.staged, None
+        if ver is None:
+            return False
+        self._discard_staged(ver)
+        return True
+
+    @staticmethod
+    def _discard_staged(ver: ModelVersion):
+        ver._retired = True
+        ver._fully_retired.set()
+        if ver.decode is not None:
+            ver.decode.kvcache.close()
+        if ver.sparse_plan is not None:
+            ver.sparse_plan.close()
+
+    def staged(self, name: str) -> Optional[ModelVersion]:
+        with self._lock:
+            slot = self._slots.get(name)
+            return slot.staged if slot is not None else None
+
     def _load_version(self, name, dirname, ladder, warm,
-                      sig=None) -> ModelVersion:
+                      manifest=None, sparse=None) -> ModelVersion:
         t0 = time.perf_counter()
+        manifest = manifest if manifest is not None \
+            else read_model_manifest(dirname)
+        sig = manifest.get("decode")
+        sparse_meta = manifest.get("sparse")
+        if sparse_meta is not None and sig is not None:
+            raise ModelUnavailableError(
+                f"model dir {dirname}: generative + distributed-sparse "
+                f"is not a supported combination")
+        if sparse_meta is not None and sparse is None:
+            raise ModelUnavailableError(
+                f"model dir {dirname} holds its lookup tables "
+                f"{sorted(sparse_meta.get('tables', {}))} in pserver "
+                f"shards (manifest `sparse` key) — pass "
+                f"sparse=fleet.SparseServeConfig(endpoints=...) to "
+                f"add_model/load so the replica can prefetch rows")
         fp = _fingerprint(dirname)
         scope = Scope()
         # verify=True: sha256 the whole dir against its MANIFEST before
         # deserializing — a bit-rotted dir raises ModelIntegrityError
         # here and the previously published version keeps serving
         program, feed_names, fetch_vars = _io.load_inference_model(
-            dirname, self._exe, scope=scope, verify=True)
+            dirname, self._exe, scope=scope, verify=True,
+            # skip exactly what the saver excluded (the manifest records
+            # it: tables + their table-sized optimizer slots); legacy
+            # sparse manifests without the list fall back to the tables
+            skip_vars=(set(sparse_meta.get("skip_vars")
+                           or sparse_meta["tables"])
+                       if sparse_meta else None))
         spec = feed_spec(program, feed_names)
         if sig is not None:
             # KV cache state is never serialized (io._is_persistable
@@ -219,12 +371,22 @@ class ModelRegistry:
         ver = ModelVersion(name, dirname, fp, program, list(feed_names),
                            [v.name for v in fetch_vars], scope, prepared,
                            ladder, spec)
+        manifest_path = os.path.join(dirname, _io.MODEL_MANIFEST)
+        if os.path.isfile(manifest_path):
+            from ..ark.checkpoint import file_sha256
+            ver.manifest_sha = file_sha256(manifest_path)
         if sig is not None:
             ver.decode = self._load_decode(ver, sig)
+        if sparse_meta is not None:
+            # the plan (and its row cache) belongs to THIS version: a hot
+            # swap retires the plan with the version — version-keyed
+            # cache invalidation by construction
+            ver.sparse_plan = sparse.build(sparse_meta, ver)
         if warm:
             self._warm(ver)
             if ver.decode is not None:
                 self._warm_decode(ver)
+            ver.warmed = True
         _metrics.counter("serve_model_loads_total",
                          "model versions loaded (incl. warmup)").inc(
                              model=name)
@@ -276,6 +438,11 @@ class ModelRegistry:
         produces ZERO recompile events — and any later unwarmed shape
         attributes as `padding_bucket`."""
         warm_feeds = warm_feed_shapes(ver.spec, ver.ladder)
+        if ver.sparse_plan is not None:
+            # the steady-state signature includes the fed sub-tables:
+            # warm with the SAME feed set (zero tables, no RPC), so the
+            # first real batch hits the compile cache
+            warm_feeds = [ver.sparse_plan.warm_feeds(f) for f in warm_feeds]
         obs = _steplog.observatory()
         for i, feeds in enumerate(warm_feeds):
             if i > 0:
@@ -348,6 +515,10 @@ class ModelRegistry:
         ver._fully_retired.set()
         if ver.decode is not None:
             ver.decode.kvcache.close()
+        if ver.sparse_plan is not None:
+            # drop the retired version's row cache (and its gauges): the
+            # swap IS the invalidation — the new version re-pulls rows
+            ver.sparse_plan.close()
 
     def release(self, ver: ModelVersion):
         with self._lock:
@@ -396,6 +567,9 @@ class ModelRegistry:
         self.stop_watch()
         with self._lock:
             for slot in self._slots.values():
+                if slot.staged is not None:
+                    self._discard_staged(slot.staged)
+                    slot.staged = None
                 if slot.current is not None:
                     slot.current._retired = True
                     if slot.current._refs == 0:

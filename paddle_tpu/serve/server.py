@@ -78,27 +78,54 @@ class InferenceServer:
                 self._pulse_check_name, self._pulse_queue_check,
                 ready=True)
 
-    def _pulse_queue_check(self):
-        """fluid-pulse /readyz check: per-model queue saturation — a
-        router should stop sending traffic here before requests start
-        bouncing off admission control. Shares the detector's threshold
-        (health.SERVE_QUEUE_SATURATION_FRAC) so the two verdicts in one
-        /healthz body can't diverge."""
-        from ..observe.health import SERVE_QUEUE_SATURATION_FRAC
-        detail, ok = {}, True
+    def model_detail(self) -> dict:
+        """Per-model readiness detail — ONE shape shared by the pulse
+        /readyz check and the fleet replica's `readyz` RPC, so the
+        router gates on identical facts whichever transport it polls:
+        the active `version` (+ content-addressed `version_key`),
+        `warmed` (every ladder bucket compiled — "right version, WARMED"
+        is the router's take-traffic condition), queue depth/capacity/
+        saturation, and whether the model is generative."""
+        detail = {}
         # snapshot: the ticker/scrape thread iterates while add_model may
         # be inserting a batcher from another thread
         for name, b in list(self._batchers.items()):
             depth, cap = b.queue_depth(), max(b._max_queue, 1)
-            sat = depth / cap
             detail[name] = {"depth": depth, "capacity": cap,
-                            "saturation": round(sat, 3),
-                            "version": None}
+                            "saturation": round(depth / cap, 3),
+                            "generative": False, "version": None,
+                            "version_key": None, "warmed": False}
+        for name, eng in list(self._engines.items()):
+            detail[name] = {"depth": None, "capacity": None,
+                            "saturation": 0.0, "generative": True,
+                            "version": None, "version_key": None,
+                            "warmed": False}
+        for name, d in detail.items():
             try:
-                detail[name]["version"] = self.registry.get(name).version_id
+                ver = self.registry.get(name)
             except Exception:
-                pass
-            if sat >= SERVE_QUEUE_SATURATION_FRAC:
+                continue   # mid-load/teardown: version stays None
+            d["version"] = ver.version_id
+            d["version_key"] = ver.version_key
+            d["warmed"] = bool(ver.warmed)
+        return detail
+
+    def _pulse_queue_check(self):
+        """fluid-pulse /readyz check: per-model queue saturation AND
+        per-model version/warm detail (the fleet router's "right
+        version, warmed" gate). Unready when any queue saturates —
+        sharing the detector's threshold
+        (health.SERVE_QUEUE_SATURATION_FRAC) so the two verdicts in one
+        /healthz body can't diverge — or when any model's active version
+        is not warmed (a router must not send traffic that would compile
+        on the request path)."""
+        from ..observe.health import SERVE_QUEUE_SATURATION_FRAC
+        detail = self.model_detail()
+        ok = True
+        for d in detail.values():
+            if d["saturation"] >= SERVE_QUEUE_SATURATION_FRAC:
+                ok = False
+            if d["version"] is not None and not d["warmed"]:
                 ok = False
         return ok, detail
 
@@ -107,14 +134,18 @@ class InferenceServer:
     def add_model(self, name: str, dirname: str,
                   ladder: Optional[BucketLadder] = None,
                   batch_timeout_ms: Optional[float] = None,
-                  max_queue: Optional[int] = None, warm: bool = True):
+                  max_queue: Optional[int] = None, warm: bool = True,
+                  sparse=None):
         """Load, verify, warm and publish a model, then start its
         executor thread. Calling again with the same name hot-swaps (and
         applies any explicitly passed batcher settings to the live
         batcher). A generative dir (decode signature in its MANIFEST)
         gets a DecodeEngine — generate/submit_stream — instead of a
-        one-shot MicroBatcher."""
-        ver = self.registry.load(name, dirname, ladder=ladder, warm=warm)
+        one-shot MicroBatcher. `sparse` (fleet.SparseServeConfig) wires
+        the serve-time distributed embedding read path for dirs whose
+        manifest declares pserver-resident lookup tables."""
+        ver = self.registry.load(name, dirname, ladder=ladder, warm=warm,
+                                 sparse=sparse)
         # a re-register may change the model's KIND (one-shot <->
         # generative): the stale request path must go, or infer() would
         # keep routing one-shot feeds at a prefill program (and
@@ -147,6 +178,24 @@ class InferenceServer:
     def reload(self, name: str, force: bool = False) -> bool:
         """Explicit hot-swap check (the watcher calls the same path)."""
         return self.registry.reload(name, force=force)
+
+    # -- fleet coordinated swap (two-phase: stage everywhere, then flip) --
+
+    def prepare_swap(self, name: str, dirname: Optional[str] = None):
+        """Stage (verify + load + warm) a new version without publishing
+        it; returns the staged ModelVersion. The router runs this on
+        every replica BEFORE any replica flips, so commit_swap is a pure
+        pointer flip and the fleet's flip window is milliseconds."""
+        return self.registry.prepare(name, dirname)
+
+    def commit_swap(self, name: str):
+        """Publish the staged version (atomic pointer flip; the old
+        version drains via refcount retirement)."""
+        return self.registry.commit(name)
+
+    def abort_swap(self, name: str) -> bool:
+        """Discard the staged version; the published one keeps serving."""
+        return self.registry.abort(name)
 
     def start_watch(self, interval_s: Optional[float] = None):
         self.registry.start_watch(interval_s if interval_s is not None

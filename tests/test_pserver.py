@@ -496,7 +496,15 @@ def test_shared_ids_feed_updates_correct_global_rows(two_servers):
 
 def test_async_ps_deepfm_sparse(two_servers):
     """DeepFM with distributed lookup tables through the PS: sub-table
-    prefetch + remap + sparse push; loss decreases (P5 milestone)."""
+    prefetch + remap + sparse push; loss decreases (P5 milestone).
+
+    Deflaked (round 16): the original 40-step / 0.9-band assertion sat
+    ON the trajectory's knee — measured first8->last8 ratios at step 40
+    range 0.66-0.89 across seeds, so suite-order jitter in the unpinned
+    program seeds flipped it. The documented trajectory at 80 steps is
+    ratio 0.05-0.12 (seeds 1/2/3/7, this rig); the program seeds are
+    now pinned and the band set at 0.5 — an order of magnitude of
+    margin on a deterministic run, still a REAL convergence gate."""
     from paddle_tpu.models import deepfm
 
     eps = ",".join(s.endpoint for s in two_servers)
@@ -507,6 +515,9 @@ def test_async_ps_deepfm_sparse(two_servers):
                                hidden_sizes=(32, 32), distributed=True)
     loss = outs["loss"]
     fluid.optimizer.Adagrad(learning_rate=0.05).minimize(loss)
+    # pinned init: the trajectory band below was measured on seed 1
+    fluid.default_main_program().random_seed = 1
+    fluid.default_startup_program().random_seed = 1
 
     cfg = fluid.DistributeTranspilerConfig()
     cfg.sparse_prefetch_cap = 256
@@ -528,10 +539,10 @@ def test_async_ps_deepfm_sparse(two_servers):
         return {"dense_input": dense, "sparse_input": ids, "label": ys}
 
     losses = []
-    for _ in range(40):
+    for _ in range(80):
         l, = tr.step(batch(), fetch_list=[loss])
         losses.append(float(np.asarray(l).reshape(-1)[0]))
-    assert np.mean(losses[-8:]) < np.mean(losses[:8]) * 0.9, losses
+    assert np.mean(losses[-8:]) < np.mean(losses[:8]) * 0.5, losses
 
     # checkpoint_notify analog: both shards saved
     import tempfile, os

@@ -37,6 +37,11 @@ Scenarios (all seed-deterministic through ark.chaos):
                   with the expected alerts (non_finite_loss,
                   ps_retry_storm) and the flight dump records both
                   alerts with the triggering series' last points
+    replica_kill  fluid-fleet: one of three serving replica PROCESSES is
+                  SIGKILLed under open-loop router traffic; PASS = zero
+                  failed requests (failovers metered; p99 degrades and
+                  is recorded), the dead replica's lease expires, and
+                  the survivors show zero steady-state recompiles
 
 `--trace-out DIR` (any scenario): every participating process writes its
 chrome trace file into DIR (`trace_<process>.json`) and the drill merges
@@ -523,8 +528,167 @@ def drill_health_alerts(seed, workdir, trace_out=None):
         fluid.set_flag("observe", False)
 
 
+def drill_replica_kill(seed, workdir, trace_out=None):
+    """fluid-fleet: SIGKILL one of three serving replicas mid-traffic.
+
+    PASS requires: zero FAILED requests (the kill's in-flight and
+    subsequent dispatches fail over to live replicas — availability is
+    preserved, p99 degrades and is recorded), router failovers metered,
+    the dead replica's membership lease expires (it stops renewing),
+    and the survivors keep serving with zero steady-state recompiles.
+    Emits a JSON line (fleet_p99_pre_kill_us / fleet_p99_post_kill_us /
+    fleet_kill_failed) that bench.py's `fleet` segment records."""
+    import json
+    import random
+    import signal
+    import threading
+
+    from paddle_tpu import fleet
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from fleet_router import spawn_replicas
+    from serve_loadgen import build_and_save
+
+    fluid.set_flag("observe", True)
+    obs_metrics.default_registry().reset()
+    mdir = os.path.join(workdir, "model")
+    build_and_save(fluid, np, mdir)
+    # poll_interval 0.5: wide enough that the victim is still marked
+    # ready when the post-kill burst below lands (the failover path,
+    # not the poller, must be what saves those requests)
+    router = fleet.FleetRouter(fleet.RouterConfig(
+        lease_s=1.0, poll_interval_s=0.5)).start()
+    workers = []
+    try:
+        workers = spawn_replicas(3, mdir, router.control_endpoint,
+                                 device_ms=2.0, lease_s=1.0)
+        deadline = time.time() + 60
+        while len(router.ready_members("m")) < 3:
+            if time.time() > deadline:
+                raise DrillFailure("fleet never became ready")
+            time.sleep(0.1)
+        print("  3 replica processes ready behind the router")
+
+        DURATION, QPS, THREADS = 6.0, 90.0, 6
+        stop = threading.Event()
+        lock = threading.Lock()
+        failures, rejected, lats = [], [0], []   # (t, us)
+        kill_at = [None]
+
+        def client(tid):
+            r = random.Random(seed * 100 + tid)
+            lam = QPS / THREADS
+            nxt = time.perf_counter()
+            while not stop.is_set():
+                nxt += r.expovariate(lam)
+                d = nxt - time.perf_counter()
+                if d > 0:
+                    time.sleep(d)
+                t0 = time.perf_counter()
+                feed = {"x": np.random.randn(
+                    r.randint(1, 4), 16).astype(np.float32)}
+                try:
+                    router.infer("m", feed)
+                except Exception as e:      # noqa: BLE001
+                    with lock:
+                        if getattr(e, "retriable", False):
+                            rejected[0] += 1
+                        else:
+                            failures.append(repr(e))
+                    continue
+                with lock:
+                    lats.append((time.perf_counter(),
+                                 (time.perf_counter() - t0) * 1e6))
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(THREADS)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(DURATION / 2)
+        victim = workers[1]
+        kill_at[0] = time.perf_counter()
+        victim.send_signal(signal.SIGKILL)
+        victim.wait(timeout=10)
+        print("  SIGKILL'd replica r1 mid-traffic")
+        # deterministic failover exposure: a tight burst INSIDE the poll
+        # window, while the router still believes r1 is ready — the
+        # requests routed at the corpse must be saved by per-request
+        # failover, not by the poller having already removed it
+        for _ in range(30):
+            t_b = time.perf_counter()
+            try:
+                router.infer("m", {"x": np.random.randn(
+                    2, 16).astype(np.float32)})
+            except Exception as e:      # noqa: BLE001
+                with lock:
+                    if getattr(e, "retriable", False):
+                        rejected[0] += 1
+                    else:
+                        failures.append(repr(e))
+                continue
+            with lock:
+                lats.append((time.perf_counter(),
+                             (time.perf_counter() - t_b) * 1e6))
+        time.sleep(DURATION / 2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=20)
+
+        def p99(window):
+            vals = sorted(us for t, us in window)
+            return vals[min(len(vals) - 1,
+                            int(0.99 * len(vals)))] if vals else 0.0
+
+        pre = [(t, us) for t, us in lats if t < kill_at[0]]
+        post = [(t, us) for t, us in lats if t >= kill_at[0]]
+        _check(not failures,
+               f"zero failed requests across the kill "
+               f"({len(lats)} served, first failure: "
+               f"{failures[0] if failures else None})")
+        _check(len(post) > 0, f"traffic kept flowing after the kill "
+                              f"({len(post)} post-kill responses)")
+        fo = obs_metrics.default_registry().get("fleet_failovers_total")
+        _check(fo is not None and fo.total() >= 1,
+               f"failovers metered ({fo.total() if fo else 0:.0f})")
+        time.sleep(2.5)   # > 2 lease periods
+        mem = router.members()
+        _check("r1" not in mem or not mem["r1"]["lease_live"],
+               "dead replica's membership lease expired")
+        recompiles = 0
+        for rid in ("r0", "r2"):
+            st = fleet.wire.call(router._members[rid].pool,
+                                 "fleet_stats", {}, deadline_s=10.0)
+            recompiles += int(st.get("unexpected_recompiles", 0))
+        _check(recompiles == 0,
+               "zero steady-state recompiles on the survivors")
+        out = {
+            "fleet_kill_failed": len(failures),
+            "fleet_kill_rejected": rejected[0],
+            "fleet_p99_pre_kill_us": round(p99(pre), 1),
+            "fleet_p99_post_kill_us": round(p99(post), 1),
+            "fleet_kill_requests_ok": len(lats),
+            "fleet_kill_failovers": fo.total() if fo else 0,
+        }
+        print(json.dumps(out))
+        print(f"  p99 {out['fleet_p99_pre_kill_us']:.0f} us pre-kill -> "
+              f"{out['fleet_p99_post_kill_us']:.0f} us post-kill "
+              f"(degraded, never failed)")
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.terminate()
+        for w in workers:
+            try:
+                w.wait(timeout=10)
+            except Exception:
+                w.kill()
+        router.close()
+        fluid.set_flag("observe", False)
+
+
 SCENARIOS = {
     "flaky_rpc": drill_flaky_rpc,
+    "replica_kill": drill_replica_kill,
     "quant_flaky_rpc": drill_quant_flaky_rpc,
     "pserver_kill": drill_pserver_kill,
     "ckpt_crash": drill_ckpt_crash,

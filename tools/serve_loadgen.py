@@ -272,6 +272,288 @@ def run_generate(args):
     return rc
 
 
+# fleet deepfm-sparse drill model shape: fields, vocab, emb K, dense D
+FLEET_DEEPFM_SHAPE = (6, 2000, 8, 4)
+
+
+def run_fleet(args):
+    """fluid-fleet drill: N replica SUBPROCESSES behind the router.
+
+    Open-loop traffic through FleetRouter.infer with three CI gates:
+    (1) zero failed requests (retriable backpressure is counted, not
+    failed) and traffic spread over every replica; (2) a mid-run
+    COORDINATED swap completes with zero version-skewed responses —
+    in router completion order, every old-version response strictly
+    precedes every new-version one; (3) zero steady-state recompiles on
+    EVERY replica process (each replica's own observatory, summed over
+    the fleet via the fleet_stats RPC). JSON carries fleet_qps /
+    fleet_p50_us / fleet_p99_us for bench.py's qps-scaling segment.
+
+    `--fleet-model deepfm-sparse` swaps the tiny MLP for a DeepFM whose
+    embedding tables live ONLY in pserver shards started by this
+    process — the end-to-end distributed sparse serving proof.
+
+    `--device-ms` (rehearsal rigs): each replica sleeps that long per
+    request in place of TPU device time, so a single-core container can
+    measure ROUTER/RPC scaling honestly (recorded in the JSON)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import fleet
+    from paddle_tpu.pserver import ParameterServer, PSClient
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from fleet_router import spawn_replicas
+
+    fluid.set_flag("observe", True)
+
+    work = tempfile.mkdtemp(prefix="fleet_loadgen_")
+    mdir = args.model_dir or os.path.join(work, "model")
+    pservers, ps_client = [], None
+    replica_args = []
+    F, N_VOCAB, K, D = FLEET_DEEPFM_SHAPE
+
+    def save_model(scale=1.0, seed=7):
+        if args.fleet_model == "mlp":
+            build_and_save(fluid, np, mdir, scale=scale, seed=seed)
+            return
+        # DeepFM whose tables exist ONLY in the pserver shards
+        from paddle_tpu.models import deepfm
+        main_p, startup = fluid.Program(), fluid.Program()
+        startup.random_seed = seed
+        with fluid.program_guard(main_p, startup), \
+                fluid.unique_name.guard():
+            _feeds, outs = deepfm.build(
+                num_fields=F, sparse_feature_dim=N_VOCAB,
+                embedding_size=K, dense_dim=D, hidden_sizes=(16, 16),
+                distributed=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        if scale != 1.0:
+            for v in main_p.global_block().vars.values():
+                if isinstance(v, fluid.Parameter):
+                    arr = np.asarray(scope.find_var(v.name))
+                    scope.set_var(v.name, arr * scale)
+        fleet.save_sparse_inference_model(
+            mdir, ["dense_input", "sparse_input"], [outs["predict"]],
+            exe, main_program=main_p, scope=scope, cap=256)
+
+    if args.fleet_model == "deepfm-sparse":
+        pservers = [ParameterServer("127.0.0.1:0").start()
+                    for _ in range(2)]
+        eps = [s.endpoint for s in pservers]
+        ps_client = PSClient(eps)
+        for wname, width in (("fm_v", K), ("fm_w", 1)):
+            ps_client.init_table(wname, N_VOCAB, width, "float32",
+                                 -0.05, 0.05, seed=1337, opt_type="sgd",
+                                 lr=0.1, attrs={})
+        replica_args = ["--sparse-endpoints", ",".join(eps)]
+        if args.sparse_quant:
+            replica_args += ["--sparse-quant", args.sparse_quant]
+    save_model()
+
+    router = fleet.FleetRouter(fleet.RouterConfig(
+        lease_s=1.5, poll_interval_s=0.2)).start()
+    workers = []
+    try:
+        workers = spawn_replicas(
+            args.replicas, mdir, router.control_endpoint,
+            extra_args=replica_args, pulse=args.replica_pulse,
+            device_ms=args.device_ms, lease_s=1.5)
+        return _run_fleet_traffic(args, router, mdir, save_model)
+    finally:
+        # EVERY exit path (including early failures) reaps the replica
+        # subprocesses — an orphaned replica would sit in done.wait()
+        # forever, eating the single core under later bench segments
+        for w in workers:
+            if w.poll() is None:
+                w.terminate()
+        for w in workers:
+            try:
+                w.wait(timeout=15)
+            except Exception:
+                w.kill()
+        router.close()
+        if ps_client is not None:
+            ps_client.close()
+        for s in pservers:
+            s.stop()
+
+
+def _run_fleet_traffic(args, router, mdir, save_model):
+    """The traffic/gates half of run_fleet (its caller owns ALL cleanup
+    in a finally, so any early return here still reaps the fleet)."""
+    import numpy as np
+    from paddle_tpu import fleet
+
+    F, N_VOCAB, _K, D = FLEET_DEEPFM_SHAPE
+    deadline = time.time() + 60
+    while len(router.ready_members("m")) < args.replicas:
+        if time.time() > deadline:
+            print("FAIL: fleet never became ready", file=sys.stderr)
+            return 1
+        time.sleep(0.1)
+
+    rng = random.Random(0)
+
+    def make_feed():
+        n = rng.randint(1, 4)
+        if args.fleet_model == "mlp":
+            return {"x": np.random.randn(n, 16).astype(np.float32)}
+        return {"dense_input":
+                np.random.randn(n, D).astype(np.float32),
+                "sparse_input":
+                np.random.randint(0, N_VOCAB,
+                                  size=(n, F)).astype(np.int64)}
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    failures, rejected = [], [0]
+    # (router completion seq, version_key, replica_id, us) — seq is the
+    # router-assigned wire-level completion order, so the skew gate
+    # cannot be inverted by client-thread scheduling between the call
+    # returning and the append landing
+    completions = []
+
+    def client(tid):
+        r = random.Random(100 + tid)
+        lam = args.qps / args.threads
+        nxt = time.perf_counter()
+        while not stop.is_set():
+            nxt += r.expovariate(lam)
+            delay = nxt - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            t0 = time.perf_counter()
+            try:
+                res = router.infer("m", make_feed(),
+                                   deadline_ms=args.deadline_ms)
+            except Exception as e:      # noqa: BLE001
+                with lock:
+                    if getattr(e, "retriable", False):
+                        rejected[0] += 1
+                    else:
+                        failures.append(repr(e))
+                continue
+            with lock:
+                completions.append(
+                    (res.seq, res.version_key, res.replica_id,
+                     (time.perf_counter() - t0) * 1e6))
+
+    swap_state = {"ok": args.no_swap, "error": None, "report": None}
+
+    def swap_drill():
+        time.sleep(args.duration / 2)
+        try:
+            save_model(scale=1.5, seed=11)
+            swap_state["report"] = router.swap("m", mdir)
+            swap_state["ok"] = True
+        except Exception as e:          # noqa: BLE001
+            swap_state["error"] = repr(e)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.threads)]
+    if not args.no_swap:
+        threads.append(threading.Thread(target=swap_drill, daemon=True))
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(args.duration)
+    stop.set()
+    for t in threads:
+        t.join(timeout=max(20, args.duration))
+    wall = time.perf_counter() - t0
+
+    # --- skew gate: old-version completions strictly precede new ones ---
+    skew_violations = 0
+    keys_in_order = []
+    for _, key, _, _ in sorted(completions):
+        if key not in keys_in_order:
+            keys_in_order.append(key)
+    first_seen = {k: i for i, k in enumerate(keys_in_order)}
+    last_rank = -1
+    for _, key, _, _ in sorted(completions):
+        rank = first_seen[key]
+        if rank < last_rank:
+            skew_violations += 1
+        last_rank = max(last_rank, rank)
+
+    # --- per-replica observatory gate + spread ---------------------------
+    recompiles, sparse_stats = 0, {}
+    served_by = {}
+    for _, _, rid, _ in completions:
+        served_by[rid] = served_by.get(rid, 0) + 1
+    for rid, m in router.members().items():
+        try:
+            st = fleet.wire.call(
+                router._members[rid].pool, "fleet_stats", {},
+                deadline_s=10.0)
+            recompiles += int(st.get("unexpected_recompiles", 0))
+            if st.get("sparse"):
+                sparse_stats[rid] = st["sparse"]
+        except Exception as e:          # noqa: BLE001
+            print(f"WARNING: fleet_stats of {rid} failed: {e!r}",
+                  file=sys.stderr)
+
+    lat = sorted(c[3] for c in completions)
+    p50, p99 = percentiles(np, lat)
+    out = {
+        "fleet_qps": round(len(completions) / wall, 1),
+        "fleet_p50_us": round(p50, 1),
+        "fleet_p99_us": round(p99, 1),
+        "fleet_replicas": args.replicas,
+        "fleet_requests_ok": len(completions),
+        "fleet_failed": len(failures),
+        "fleet_rejected": rejected[0],
+        "fleet_skew_violations": skew_violations,
+        "fleet_versions_seen": len(keys_in_order),
+        "fleet_swap_ok": bool(swap_state["ok"]),
+        "fleet_recompiles": recompiles,
+        "fleet_served_by": served_by,
+        "fleet_model": args.fleet_model,
+        "fleet_device_ms_simulated": args.device_ms,
+        "fleet_offered_qps": args.qps,
+    }
+    if sparse_stats:
+        out["fleet_sparse"] = sparse_stats
+    print(json.dumps(out))
+
+    rc = 0
+    if failures:
+        print(f"FAIL: {len(failures)} failed request(s); first: "
+              f"{failures[0]}", file=sys.stderr)
+        rc = 1
+    if skew_violations:
+        print(f"FAIL: {skew_violations} version-SKEWED response(s) — "
+              f"an old-version response completed after a new-version "
+              f"one (coordinated swap broke its drain contract)",
+              file=sys.stderr)
+        rc = 1
+    if not swap_state["ok"]:
+        print(f"FAIL: coordinated swap did not land "
+              f"({swap_state['error']})", file=sys.stderr)
+        rc = 1
+    if recompiles:
+        print(f"FAIL: {recompiles} steady-state recompile(s) across the "
+              f"fleet (per-replica observatory)", file=sys.stderr)
+        rc = 1
+    if len(served_by) < args.replicas and not args.no_swap:
+        # a replica that served nothing means dispatch never spread —
+        # tolerated only if it joined late/died; with none of that in
+        # this drill, flag it
+        print(f"FAIL: only {sorted(served_by)} of {args.replicas} "
+              f"replicas served traffic", file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print(f"fleet loadgen OK: {out['fleet_qps']} qps over "
+              f"{args.replicas} replica(s), p50 {p50:.0f} us / p99 "
+              f"{p99:.0f} us, swap skew-free, zero failed requests, "
+              f"zero fleet recompiles", file=sys.stderr)
+    return rc
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description="fluid-serve load generator")
     ap.add_argument("--workload", choices=("oneshot", "generate"),
@@ -309,7 +591,31 @@ def main(argv=None):
                     help="per-request deadline (default none)")
     ap.add_argument("--no-swap", action="store_true",
                     help="skip the mid-run hot-swap drill")
+    ap.add_argument("--replicas", type=int, default=0, metavar="N",
+                    help="fluid-fleet mode: spawn N replica SUBPROCESSES "
+                    "behind a FleetRouter and drive the open loop "
+                    "through it (QPS scaling + skew-free coordinated "
+                    "swap + per-replica recompile gates)")
+    ap.add_argument("--fleet-model", choices=("mlp", "deepfm-sparse"),
+                    default="mlp",
+                    help="fleet mode model: tiny MLP, or a DeepFM whose "
+                    "embedding tables live only in pserver shards "
+                    "(serve-time distributed sparse lookup)")
+    ap.add_argument("--sparse-quant", default=None,
+                    help="fleet deepfm-sparse: wire codec for row pulls")
+    ap.add_argument("--replica-pulse", action="store_true",
+                    help="fleet mode: replicas arm fluid-pulse and the "
+                    "router polls real HTTP /readyz")
+    ap.add_argument("--device-ms", type=float, default=0.0,
+                    help="fleet mode, REHEARSAL RIGS: simulated "
+                    "per-request device time per replica (sleep) so a "
+                    "single-core container measures router/RPC scaling")
     args = ap.parse_args(argv)
+
+    if args.replicas:
+        if args.workload != "oneshot":
+            ap.error("--replicas currently drives the oneshot workload")
+        return run_fleet(args)
 
     if args.workload == "generate":
         if args.emit_trace or args.ladder_from:
