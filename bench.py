@@ -513,6 +513,24 @@ def fleet_subprocess():
     return out
 
 
+def haven_subprocess():
+    """fluid-haven numbers (tools/haven_bench.py — the replicated PS
+    plane is host TCP + numpy): steady-state sync-PS step-time overhead
+    of primary/backup replication with the int8 wire codec on
+    (acceptance: <= 10%, measured under the fleet segment's simulated-
+    device-time convention — the backup's apply CPU belongs to another
+    host on a real deployment), and the failover blip — the wall-time
+    gap in trainer step completions across a primary SIGKILL, which
+    must land under lease time + one retry/resolve budget."""
+    rec, rc = _tool_json("haven_bench.py", "haven bench", timeout=420)
+    if rec is None:
+        return {"haven_repl_overhead_pct": -1.0,
+                "ps_failover_blip_ms": 0.0, "ps_failover_ok": False}
+    if rc:
+        rec["haven_bench_rc"] = rc
+    return rec
+
+
 def planner_subprocess(peak_tflops, measured_mfu):
     """fluid-planner agreement segment (tools/paddle_plan.py, CPU
     subprocess — the plan is a static walk, no device work): predicted
@@ -974,6 +992,11 @@ def main():
     _obs.flight.set_stage("wire_bench_subprocess")
     wirebench = wire_bench_subprocess()
     note(**wirebench)
+    # fluid-haven: replicated-PS steady-state overhead + failover blip
+    _PARTIAL["extra"]["failure_stage"] = "haven_subprocess"
+    _obs.flight.set_stage("haven_subprocess")
+    havenrec = haven_subprocess()
+    note(**havenrec)
     # the headline pair is drift-sensitive through the dev tunnel, and
     # the noise is ONE-SIDED: a stall can only lower a reading below the
     # true device rate, never raise it (the device cannot run faster
@@ -1081,6 +1104,21 @@ def main():
             "wire_sparse_compression_x", 0.0),
         "wire_quant_loss_delta": wirebench.get(
             "wire_quant_loss_delta", -1.0),
+        # fluid-haven (CPU subprocess, replicated sync-PS pair): steady-
+        # state replication overhead (acceptance <= 10% with codecs on)
+        # and the trainer-observed failover blip vs its lease+retry
+        # budget across a primary SIGKILL
+        "haven_repl_overhead_pct": havenrec.get(
+            "haven_repl_overhead_pct", -1.0),
+        "haven_step_ms_single": havenrec.get("haven_step_ms_single", 0.0),
+        "haven_step_ms_replicated": havenrec.get(
+            "haven_step_ms_replicated", 0.0),
+        "haven_device_ms_simulated": havenrec.get(
+            "haven_device_ms_simulated", 0.0),
+        "ps_failover_blip_ms": havenrec.get("ps_failover_blip_ms", 0.0),
+        "ps_failover_budget_ms": havenrec.get(
+            "ps_failover_budget_ms", 0.0),
+        "ps_failover_ok": havenrec.get("ps_failover_ok", False),
         # both readings behind the keep-the-max headline metrics, so the
         # recorded JSON preserves the spread (advisor r5)
         "transformer_base_wmt_tokens_per_sec_first": round(tok_unf_first, 0),

@@ -83,6 +83,7 @@ from . import pserver  # noqa: F401
 from . import ark  # noqa: F401  (fluid-ark fault-tolerant training)
 from . import serve  # noqa: F401  (fluid-serve TPU inference serving)
 from . import fleet  # noqa: F401  (fluid-fleet multi-replica serving tier)
+from . import haven  # noqa: F401  (fluid-haven replicated PS plane)
 from . import master  # noqa: F401
 from . import recordio  # noqa: F401
 from .trainer import (Trainer, Inferencer, CheckpointConfig,  # noqa: F401

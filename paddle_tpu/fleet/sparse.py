@@ -26,6 +26,14 @@ cache is keyed to its ModelVersion and dropped when the version retires,
 so a hot swap IS the invalidation point — a model push that retrains
 embeddings swaps the dir and every replica re-pulls. ``invalidate()``
 exists for out-of-band refreshes.
+
+fluid-haven: with the pserver shards running as replicated pairs, pass
+``SparseServeConfig(endpoints=[primary], replicas={primary: [backup]})``
+— a standby backup serves bounded-stale row reads WITHOUT promotion, so
+a primary SIGKILL never takes the serving plane down with it (the read
+fails over per-request; after a handover the retired primary's redirect
+moves the client to the successor). Pinned by
+``tests/test_haven.py::test_fleet_sparse_row_pulls_survive_primary_kill``.
 """
 
 from __future__ import annotations

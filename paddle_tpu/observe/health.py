@@ -31,6 +31,11 @@ paper's per-task health story. Three pieces:
   * ``lease_churn``          evictions+readmissions above a windowed rate
   * ``fleet_failover_storm`` router request failovers above a windowed
                              rate — replica membership is flapping
+  * ``ps_replication_stall`` fluid-haven: the replication lag grows
+                             monotonically over a window while pushes
+                             keep landing — the backup stopped
+                             keeping up (self-clears when the ack
+                             watermark moves again)
   * ``wire_compression_collapse`` on-wire ratio fell to half of the
                              session's established ratio
 
@@ -449,6 +454,44 @@ class KvCacheExhaustionDetector(CapacityRatioDetector):
             "(>= {frac:.0%}) — generative admissions about to stall")
 
 
+class ReplicationStallDetector(Detector):
+    """fluid-haven: the primary's replication lag
+    (`ps_replication_lag_updates` gauge, fed from the ack watermark)
+    grew MONOTONICALLY across the window while pushes kept being served
+    — the backup is alive enough to hold the connection but not keeping
+    up, so the failover loss bound is eroding toward the full window.
+    Idle lag (no pushes) never fires: a paused trainer is not a stall.
+    Self-clears as soon as the watermark catches up (lag dips)."""
+
+    name = "ps_replication_stall"
+    series = "ps_replication_lag"
+
+    def __init__(self, window_s: float = 20.0, min_points: int = 4):
+        self.window_s = window_s
+        self.min_points = min_points
+
+    def check(self, engine, now):
+        pts = [(ts, v) for ts, v in engine.series(self.series).points()
+               if ts > now - self.window_s]
+        if len(pts) < self.min_points:
+            engine.clear(self)
+            return
+        vals = [v for _ts, v in pts]
+        growing = all(b >= a for a, b in zip(vals, vals[1:])) \
+            and vals[-1] > vals[0] and vals[-1] > 0
+        pushes, _n = engine.series("ps_push_serves").window_sum(
+            self.window_s, now=now)
+        if growing and pushes > 0:
+            engine.fire(self, observed=vals[-1], threshold=vals[0],
+                        message=f"replication lag grew {vals[0]:.0f} -> "
+                                f"{vals[-1]:.0f} updates over "
+                                f"{self.window_s:.0f}s while "
+                                f"{pushes:.0f} pushes landed — backup "
+                                f"not keeping up")
+        else:
+            engine.clear(self)
+
+
 class CompressionCollapseDetector(Detector):
     """fluid-wire ratio collapse: the windowed raw/on-wire byte ratio
     fell to half of the best ratio this session established. A session
@@ -501,6 +544,18 @@ DEFAULT_WATCHES = (
     # fluid-fleet: router-side failovers (a replica answered a request
     # another replica dropped) — a storm means replicas are flapping
     ("fleet_failovers_total", "fleet_failovers", None),
+    # fluid-haven: replication lag levels (gauge) + the push traffic
+    # that distinguishes a stalling backup from an idle trainer — one
+    # spec per push command (the watch filter is exact-match)
+    ("ps_replication_lag_updates", "ps_replication_lag", None),
+    ("pserver_server_requests_total", "ps_push_serves",
+     {"cmd": "push_grad"}),
+    ("pserver_server_requests_total", "ps_push_serves",
+     {"cmd": "push_grads"}),
+    ("pserver_server_requests_total", "ps_push_serves",
+     {"cmd": "push_grads_sync"}),
+    ("pserver_server_requests_total", "ps_push_serves",
+     {"cmd": "push_sparse_grad"}),
 )
 
 
@@ -622,6 +677,7 @@ class HealthEngine:
                     RateSpikeDetector("fleet_failover_storm",
                                       "fleet_failovers",
                                       window_s=15.0, threshold=8.0),
+                    ReplicationStallDetector(),
                     CompressionCollapseDetector()):
             self.add_detector(det)
         self._ensure_watches()   # arms only the not-yet-armed specs

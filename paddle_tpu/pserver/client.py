@@ -63,7 +63,10 @@ class PSClient:
                  replicas: Optional[Dict[str, Sequence[str]]] = None,
                  wire_trace: bool = True,
                  comm_quant: Optional[str] = None,
-                 read_only: bool = False):
+                 read_only: bool = False,
+                 dedup_pushes: bool = False,
+                 trainer_id: int = 0,
+                 failover_s: float = 20.0):
         # fluid-fleet: a serving replica's sparse read path holds a
         # PSClient purely to PULL rows — read_only=True makes a mutating
         # call (a stray push_grad from a serving process would corrupt
@@ -93,6 +96,24 @@ class PSClient:
             else self.retry.deadline
         self.replicas = {ep: list(reps)
                          for ep, reps in (replicas or {}).items()}
+        # fluid-haven: logical endpoint -> CURRENT primary. Writes (and
+        # reads) are routed through this map; it moves on a redirect
+        # reply or a successful `_resolve_primary` poll after a primary
+        # death. `failover_s` bounds how long a write waits for the
+        # backup's lease-expiry promotion before giving up.
+        self._primaries: Dict[str, str] = {}
+        self.failover_s = float(failover_s)
+        # fluid-haven exactly-once for BARRIERLESS pushes: when armed,
+        # push_grad(s)/push_sparse_grad carry (trainer, seq, session) so
+        # the server's async watermark makes them replay-safe — the rule
+        # that lets a push retried at a promoted backup never
+        # double-apply. Off by default: the wire stays byte-identical.
+        self.dedup_pushes = bool(dedup_pushes)
+        self.trainer_id = int(trainer_id)
+        import uuid
+        self._session = uuid.uuid4().hex
+        self._push_seq = 0
+        self._push_seq_lock = threading.Lock()
         self._socks = {}
         self._lock = threading.Lock()
         self._ep_locks: Dict[str, threading.Lock] = {}
@@ -160,7 +181,12 @@ class PSClient:
     # whose send failed was never dispatched by the server.
     _IDEMPOTENT = frozenset({"get_param", "get_params", "prefetch",
                              "init_param", "init_table", "stats",
-                             "heartbeat", "save", "restore", "wire_caps"})
+                             "heartbeat", "save", "restore", "wire_caps",
+                             # fluid-haven: replicate dedups by seq, sync
+                             # replaces state wholesale, promote fences
+                             # by epoch, role is a read
+                             "haven_role", "haven_replicate",
+                             "haven_sync", "haven_promote"})
 
     # strictly read-only commands: the ONLY ones allowed to fail over to
     # a replica endpoint. Idempotent-but-mutating commands (save,
@@ -174,8 +200,13 @@ class PSClient:
     def _replayable(cls, cmd, payload) -> bool:
         if cmd in cls._IDEMPOTENT:
             return True
-        return cmd == "push_grads_sync" and \
-            payload.get("batch_id") is not None
+        if cmd == "push_grads_sync":
+            return payload.get("batch_id") is not None
+        # fluid-haven: tagged barrierless pushes dedup server-side on
+        # (trainer, seq, session) — replay-safe, including at a
+        # promoted backup after a primary failover
+        return cmd in ("push_grad", "push_grads", "push_sparse_grad") \
+            and payload.get("seq") is not None
 
     # commands that legitimately block for a long time (barriers): a
     # default deadline would break them, so only an explicit per-call
@@ -184,12 +215,104 @@ class PSClient:
 
     # commands a read_only client may issue: the read set plus the
     # negotiation/introspection commands that mutate nothing server-side
+    # (haven_role is how a serve-time client re-resolves a shard's
+    # primary after a redirect)
     _READ_ONLY_ALLOWED = frozenset({"get_param", "get_params", "prefetch",
-                                    "stats", "wire_caps"})
+                                    "stats", "wire_caps", "haven_role"})
+
+    def _phys(self, endpoint: str) -> str:
+        """The physical endpoint currently serving logical `endpoint` —
+        identity until a haven failover/redirect moves the mapping."""
+        return self._primaries.get(endpoint, endpoint)
+
+    def _resolve_primary(self, endpoint, wait: bool = True) -> bool:
+        """Re-resolve which member of `endpoint`'s replica group is the
+        PRIMARY by polling `haven_role` on every member; with `wait`,
+        keep polling up to `failover_s` so a backup's lease-expiry
+        promotion has time to land. Returns True when the mapping
+        moved.
+
+        Eligibility is deliberately asymmetric: the ORIGINAL endpoint
+        counts as the writer whatever it answers (haven primary, solo,
+        or a pre-haven server that rejects the command — it IS its
+        shard's only writer), but a REPLICA member only wins with an
+        explicit `role == "primary"` — a legacy read-replica listed for
+        read failover must never be adopted as a write target. Waiting
+        is justified only while some member reports `role == "backup"`
+        (a standby that may still promote); against a plain dead server
+        with legacy replicas this returns immediately."""
+        cands = []
+        for ep in [self._phys(endpoint), endpoint,
+                   *self.replicas.get(endpoint, ())]:
+            if ep not in cands:
+                cands.append(ep)
+        deadline = time.monotonic() + (self.failover_s if wait else 0.0)
+        while True:
+            best, saw_standby, hints = None, False, []
+            for ep in cands:
+                try:
+                    (status, value), _tx, _rx = self._call_one(
+                        ep, "haven_role", {}, 1.0, False, None)
+                except (ConnectionError, EOFError, OSError):
+                    continue
+                if status == "ok":
+                    role = value.get("role")
+                    epoch = value.get("epoch", -1)
+                    # a standby/retired member ADVERTISES its primary:
+                    # after a handover to a brand-new endpoint no
+                    # configured candidate may be the primary at all —
+                    # the hint is the only road to it
+                    hint = value.get("primary")
+                    if hint and hint not in cands and hint not in hints:
+                        hints.append(hint)
+                elif status == "err" and \
+                        "unknown pserver command" in str(value):
+                    role, epoch = "solo", -1   # pre-haven server
+                else:
+                    continue
+                if role == "backup":
+                    saw_standby = True
+                    continue
+                if role == "primary" or \
+                        (role == "solo" and ep == endpoint):
+                    if best is None or epoch > best[1]:
+                        best = (ep, epoch)
+            if best is None and hints:
+                cands.extend(hints)
+                continue   # poll the advertised primary immediately
+            if best is not None:
+                new = best[0]
+                changed = new != self._phys(endpoint)
+                if changed:
+                    if new == endpoint:
+                        self._primaries.pop(endpoint, None)
+                    else:
+                        self._primaries[endpoint] = new
+                    _flight.note("haven_resolved", endpoint=endpoint,
+                                 primary=new, epoch=best[1])
+                return changed
+            if not wait or not saw_standby \
+                    or time.monotonic() >= deadline:
+                return False
+            time.sleep(0.25)
 
     def _call(self, endpoint, cmd, _deadline=..., **payload):
-        """One RPC with retry/backoff/deadline; `_deadline=...` (unset)
-        follows the client default, None disables, a float overrides."""
+        """One logical RPC with retry/backoff/deadline; `_deadline=...`
+        (unset) follows the client default, None disables, a float
+        overrides.
+
+        fluid-haven routing: the call targets the shard's CURRENT
+        primary (`self._primaries`). A `redirect` reply (standby backup
+        or retired server) moves the mapping and retries — the redirect
+        preceded dispatch, so ANY command is safe to reissue. A
+        transport failure of every member extends the old read-only
+        failover rule to WRITES: for replay-safe commands (reads,
+        first-wins inits, batch-tagged sync pushes, seq-tagged async
+        pushes) the client re-resolves the primary — polling
+        `haven_role` while the backup's lease-expiry promotion lands —
+        and replays there; the server-side (trainer, batch/seq, nonce)
+        watermarks make the replay exactly-once even when the dead
+        primary had already applied and replicated it."""
         if self.read_only and cmd not in self._READ_ONLY_ALLOWED:
             raise RuntimeError(
                 f"PSClient(read_only=True) refuses mutating command "
@@ -207,37 +330,84 @@ class PSClient:
         # the same parent span.
         call_ctx = _xray.child_of() if obs else None
         ts_wall = time.time() if obs else 0.0
-        candidates = [endpoint]
-        if cmd in self._READ_ONLY:
-            candidates += [ep for ep in self.replicas.get(endpoint, ())
-                           if ep != endpoint]
-        last_err = None
         served_ep, call_outcome = endpoint, "failed"
+        status, value, tx, rx = "err", "unresolved", 0, 0
         try:
-            for i, ep in enumerate(candidates):
-                try:
-                    (status, value), tx, rx = self._call_one(
-                        ep, cmd, payload, _deadline, obs, call_ctx)
-                    served_ep = ep
-                    call_outcome = "ok" if status == "ok" else "err_reply"
-                    break
-                except (ConnectionError, EOFError, OSError) as e:
-                    last_err = e
-                    if i + 1 < len(candidates) and obs:
-                        _metrics.counter(
-                            "pserver_client_failovers_total",
-                            "reads rerouted to a replica endpoint").inc(
-                                cmd=cmd, frm=ep)
-                        _flight.note("rpc_failover", cmd=cmd, frm=ep,
-                                     to=candidates[i + 1],
-                                     error=type(e).__name__)
-                    continue
+            for _hop in range(4):
+                primary = self._phys(endpoint)
+                candidates = [primary]
+                if cmd in self._READ_ONLY:
+                    candidates += [
+                        ep for ep in ([endpoint]
+                                      + self.replicas.get(endpoint, []))
+                        if ep not in candidates]
+                last_err = None
+                reply = None
+                for i, ep in enumerate(candidates):
+                    try:
+                        reply, tx, rx = self._call_one(
+                            ep, cmd, payload, _deadline, obs, call_ctx)
+                        served_ep = ep
+                        break
+                    except (ConnectionError, EOFError, OSError) as e:
+                        last_err = e
+                        if i + 1 < len(candidates) and obs:
+                            _metrics.counter(
+                                "pserver_client_failovers_total",
+                                "reads rerouted to a replica "
+                                "endpoint").inc(cmd=cmd, frm=ep)
+                            _flight.note("rpc_failover", cmd=cmd, frm=ep,
+                                         to=candidates[i + 1],
+                                         error=type(e).__name__)
+                        continue
+                if reply is None:
+                    # every member transport-failed: a replay-safe call
+                    # against a haven pair waits out the promotion and
+                    # replays at the re-resolved primary
+                    if self.replicas.get(endpoint) and \
+                            self._replayable(cmd, payload) and \
+                            self._resolve_primary(
+                                endpoint, wait=cmd != "heartbeat"):
+                        if obs:
+                            _metrics.counter(
+                                "pserver_client_primary_failovers_total",
+                                "calls replayed at a re-resolved shard "
+                                "primary").inc(cmd=cmd)
+                        _flight.note("haven_failover", cmd=cmd,
+                                     frm=primary,
+                                     to=self._phys(endpoint))
+                        continue
+                    if obs:
+                        _flight.note("rpc_outcome", cmd=cmd,
+                                     endpoint=endpoint, outcome="failed",
+                                     error=type(last_err).__name__)
+                    raise last_err
+                status, value = reply
+                if status == "redirect":
+                    new = (value or {}).get("primary")
+                    moved = False
+                    if new and new != self._phys(endpoint):
+                        self._primaries[endpoint] = new
+                        moved = True
+                    elif self.replicas.get(endpoint) or not new:
+                        moved = self._resolve_primary(endpoint)
+                    if moved:
+                        if obs:
+                            _metrics.counter(
+                                "pserver_client_primary_failovers_total",
+                                "calls replayed at a re-resolved shard "
+                                "primary").inc(cmd=cmd)
+                        _flight.note("haven_redirect", cmd=cmd,
+                                     frm=served_ep,
+                                     to=self._phys(endpoint))
+                        continue
+                    status, value = "err", \
+                        f"NotPrimary: no reachable primary ({value})"
+                call_outcome = "ok" if status == "ok" else "err_reply"
+                break
             else:
-                if obs:
-                    _flight.note("rpc_outcome", cmd=cmd, endpoint=endpoint,
-                                 outcome="failed",
-                                 error=type(last_err).__name__)
-                raise last_err
+                status, value = "err", ("redirect loop: the shard's "
+                                        "primary keeps moving")
         finally:
             # attribute the logical call to the endpoint that actually
             # served it (after a failover that is the replica, not the
@@ -476,6 +646,22 @@ class PSClient:
             commit()
         return out
 
+    def _push_tag(self) -> Optional[dict]:
+        """(seq, trainer, session) identity for ONE tagged barrierless
+        push (fluid-haven). The seq is assigned once per logical push
+        and stays stable across transport retries AND primary
+        failovers, so the server-side async watermark acknowledges a
+        replay without re-applying. Seqs are monotone per endpoint
+        because a trainer issues its pushes sequentially (the
+        per-endpoint fanout parallelism never races two pushes to one
+        endpoint)."""
+        if not self.dedup_pushes:
+            return None
+        with self._push_seq_lock:
+            self._push_seq += 1
+            return {"seq": self._push_seq, "trainer_id": self.trainer_id,
+                    "session": self._session}
+
     # -- dense ------------------------------------------------------------
     def init_param(self, endpoint, name, value, opt_type, lr, attrs):
         self._call(endpoint, "init_param", name=name,
@@ -487,16 +673,17 @@ class PSClient:
 
     def push_grad(self, endpoint, name, grad):
         grad = np.asarray(grad)
+        tag = self._push_tag() or {}
         codec = self._codec_for(endpoint)
         if codec is None or grad.dtype != np.float32:
             self._account_wire("push_grad", grad.nbytes, grad.nbytes)
-            self._call(endpoint, "push_grad", name=name, grad=grad)
+            self._call(endpoint, "push_grad", name=name, grad=grad, **tag)
             return
         payload, commit = self._feedback.encode((endpoint, name), grad,
                                                 codec, name=name)
         self._account_wire("push_grad", grad.nbytes,
                            _wire.payload_nbytes(payload))
-        self._call(endpoint, "push_grad", name=name, grad=payload)
+        self._call(endpoint, "push_grad", name=name, grad=payload, **tag)
         commit()
 
     def _fanout_each(self, calls: Dict[str, object]) -> Dict[str, object]:
@@ -523,8 +710,8 @@ class PSClient:
 
     def push_grads_parallel(self, by_ep: Dict[str, Dict[str, np.ndarray]]):
         self._fanout_each(
-            {ep: (lambda ep=ep, grads=grads:
-                  self._push_grads_one(ep, "push_grads", grads))
+            {ep: (lambda ep=ep, grads=grads, tag=self._push_tag():
+                  self._push_grads_one(ep, "push_grads", grads, tag))
              for ep, grads in by_ep.items()})
 
     # -- sparse -------------------------------------------------------------
@@ -612,7 +799,8 @@ class PSClient:
             self._account_wire("push_sparse_grad", sub.nbytes,
                                _wire.payload_nbytes(payload))
             self._call(ep, "push_sparse_grad", name=name,
-                       local_ids=ids[mask] // n, row_grads=payload)
+                       local_ids=ids[mask] // n, row_grads=payload,
+                       **(self._push_tag() or {}))
 
     # -- sync mode (reference RunSyncLoop) ----------------------------------
     def push_grads_sync(self, by_ep: Dict[str, Dict[str, np.ndarray]],

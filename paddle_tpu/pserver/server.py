@@ -33,6 +33,7 @@ from .. import flags as _flags
 from ..wire import codec as _wire_codec
 from ..ark import checkpoint as ark_ckpt
 from ..ark.liveness import EvictingBarrier, LeaseTable
+from ..haven import replication as _haven
 from ..observe import flight as _flight
 from ..observe import metrics as _metrics
 from ..observe import xray as _xray
@@ -96,6 +97,16 @@ class ParameterServer:
         self._sync_applied: Dict[int, int] = {}     # trainer -> batch id
         self._sync_sessions: Dict[int, object] = {}  # trainer -> nonce
         self._sync_pending_from: set = set()
+        # exactly-once ASYNC accounting (fluid-haven): tagged barrierless
+        # pushes carry a per-trainer monotone seq under a session nonce —
+        # the async twin of the sync watermark above, which is what makes
+        # a push replayed at a PROMOTED backup safe to ack-and-drop
+        self._async_applied: Dict[int, int] = {}    # trainer -> push seq
+        self._async_sessions: Dict[int, object] = {}
+        self._async_lock = threading.Lock()
+        # fluid-haven replication state (armed by start_replication /
+        # start_standby; None = the legacy solo server, zero new cost)
+        self._haven = None
         # liveness (ark): heartbeat leases + an evicting barrier — a dead
         # leaseholder is evicted once its lease expires, degrading the
         # sync world to N-1 instead of wedging until sync_timeout.
@@ -177,6 +188,9 @@ class ParameterServer:
         unanswered, waiting clients see EOF/RST), and the endpoint's
         port frees up so a restarted server can bind it."""
         self._stop.set()
+        if self._haven is not None:
+            # a killed process's forwarder/monitor threads die with it
+            self._haven.close()
         if self.pulse_port is not None:
             from ..observe import health as _health
             _health.get_engine().unregister_check(
@@ -311,7 +325,26 @@ class ParameterServer:
         handler = getattr(self, f"_h_{cmd}", None)
         if handler is None:
             raise ValueError(f"unknown pserver command {cmd!r}")
-        return handler(**p)
+        hv = self._haven
+        if hv is None:   # legacy solo server: zero haven cost
+            return handler(**p)
+        # fluid-haven serve gate: a standby backup redirects mutations to
+        # the primary (reads pass, bounded-stale); a retired server
+        # redirects everything to its successor; a quiescing primary
+        # HOLDS mutators so a snapshot/handover cut is consistent.
+        with hv.admit(cmd) as verdict:
+            if verdict is not None:
+                return verdict
+            reply = handler(**p)
+            # replicate the applied update to the backup — but never a
+            # deduplicated replay (the backup saw the original record).
+            # push_grads_sync records itself under the pending lock.
+            if cmd in _haven.DISPATCH_RECORDED_CMDS and \
+                    reply[0] == "ok" and \
+                    not (isinstance(reply[1], str)
+                         and reply[1].startswith("duplicate")):
+                hv.record(cmd, p)
+            return reply
 
     def _lock(self, name):
         with self._global_lock:
@@ -334,15 +367,51 @@ class ParameterServer:
                 return ("err", f"param {name!r} not initialized")
             return ("ok", self._dense[name].copy())
 
-    def _h_push_grad(self, name, grad):
+    def _async_seen(self, seq, trainer_id, session) -> bool:
+        """fluid-haven exactly-once for tagged BARRIERLESS pushes: the
+        async twin of the sync watermark. `seq` increases monotonically
+        per trainer session; a push at or below the watermark was
+        already applied (possibly by the pre-failover primary, already
+        replicated here) and is acknowledged without re-applying — the
+        rule that lets a client replay un-acked pushes at a promoted
+        backup. Untagged pushes (seq None) keep legacy apply-always.
+
+        Check-only: the watermark COMMITS via `_async_mark` after the
+        apply succeeds — a push that failed to decode or apply must not
+        burn its seq, or the client's replay would be acked as a
+        duplicate of an update that never landed (silent loss). The
+        trade-off (a retry of a partially-applied multi-param push
+        re-applies its prefix) only arises from server-side apply bugs,
+        where loud double-apply beats silent drop."""
+        if seq is None:
+            return False
+        with self._async_lock:
+            if session is not None and \
+                    self._async_sessions.get(trainer_id) != session:
+                self._async_sessions[trainer_id] = session
+                self._async_applied.pop(trainer_id, None)
+            return seq <= self._async_applied.get(trainer_id, -1)
+
+    def _async_mark(self, seq, trainer_id):
+        if seq is None:
+            return
+        with self._async_lock:
+            if seq > self._async_applied.get(trainer_id, -1):
+                self._async_applied[trainer_id] = seq
+
+    def _h_push_grad(self, name, grad, seq=None, trainer_id=0,
+                     session=None):
         """Barrierless: apply immediately (RunAsyncLoop semantics).
         fluid-wire: the grad may arrive as a codec-tagged payload — it is
         DEQUANTIZED here, before the optimizer applies (the server-side
         half of the wire contract); raw arrays pass through unchanged, so
         legacy clients interoperate."""
         g = _wire_codec.maybe_decode(grad)  # decode outside the lock
+        if self._async_seen(seq, trainer_id, session):
+            return ("ok", "duplicate: push already applied")
         with self._lock(name):
             self._optim[name].dense(self._dense[name], g)
+        self._async_mark(seq, trainer_id)
         return ("ok", None)
 
     def _h_get_params(self, names):
@@ -354,16 +423,18 @@ class ParameterServer:
                 out[n] = self._dense[n].copy()
         return ("ok", out)
 
-    def _h_push_grads(self, grads):
+    def _h_push_grads(self, grads, seq=None, trainer_id=0, session=None):
         # decode EVERY tensor before applying ANY (and outside the
-        # locks): async pushes have no batch-id dedup, so a malformed
-        # frame must reject the whole push — a partial apply would be
-        # re-applied by the caller's retry
+        # locks): a malformed frame must reject the whole push — a
+        # partial apply would be re-applied by the caller's retry
         decoded = [(n, _wire_codec.maybe_decode(g))
                    for n, g in grads.items()]
+        if self._async_seen(seq, trainer_id, session):
+            return ("ok", "duplicate: push already applied")
         for n, dec in decoded:
             with self._lock(n):
                 self._optim[n].dense(self._dense[n], dec)
+        self._async_mark(seq, trainer_id)
         return ("ok", None)
 
     # -- wire negotiation (fluid-wire) ------------------------------------
@@ -397,12 +468,17 @@ class ParameterServer:
             return ("ok", _wire_codec.encode_tensor(rows, codec, name=name))
         return ("ok", rows)
 
-    def _h_push_sparse_grad(self, name, local_ids, row_grads):
-        rows = _wire_codec.maybe_decode(row_grads)  # decode outside lock
+    def _h_push_sparse_grad(self, name, local_ids, row_grads, seq=None,
+                            trainer_id=0, session=None):
+        # decode BEFORE the watermark advances (see _h_push_grad)
+        rows = _wire_codec.maybe_decode(row_grads)
+        if self._async_seen(seq, trainer_id, session):
+            return ("ok", "duplicate: push already applied")
         with self._lock(name):
             table = self._sparse[name]
             self._optim[name].sparse(table.value, np.asarray(local_ids),
                                      rows)
+        self._async_mark(seq, trainer_id)
         return ("ok", None)
 
     # -- sync-mode barrier (reference RunSyncLoop batch barrier) -----------
@@ -460,15 +536,53 @@ class ParameterServer:
             for n, g in decoded.items():
                 self._pending[n] = (g if n not in self._pending
                                     else self._pending[n] + g)
+            if self._haven is not None:
+                # fluid-haven: record INSIDE the pending lock (not at
+                # dispatch-return) so the log order equals the
+                # accumulation order — with 3+ concurrent trainers a
+                # post-lock record could log in a different order than
+                # the pending sum folded, and float non-associativity
+                # would break the backup's bit-identity. The record
+                # carries the ORIGINAL (possibly codec-tagged) grads.
+                self._haven.record(
+                    "push_grads_sync",
+                    {"grads": grads, "batch_id": batch_id,
+                     "trainer_id": trainer_id, "session": session})
         return ("ok", None)
 
-    def _apply_pending(self):
+    def _apply_pending(self, n_contrib=None, replicated=False):
         """Barrier action: runs exactly once per batch, in one of the
         waiting connection threads, before any trainer is released. The
         aggregated gradient is AVERAGED over trainers (each trainer's
         grad is the mean over its local shard, so the applied update
         equals single-process training on the combined batch — the
-        ParallelExecutor CoeffNumDevice convention)."""
+        ParallelExecutor CoeffNumDevice convention).
+
+        fluid-haven: a replicating primary records the apply as one
+        synthesized record carrying the contributor count, INSIDE the
+        pending lock so it orders exactly between this batch's pushes
+        and the next batch's; the backup replays it with the same
+        divisor (`n_contrib` set, `replicated=True`) instead of
+        re-deriving one from its own barrier (it has none). The
+        sync_apply DISPATCH is not a counted mutator (a barrier wait
+        must never hold a quiesce hostage) — the actual state mutation
+        enters the gate here instead."""
+        if self._haven is not None and not replicated:
+            with self._haven.mutator():
+                if self._haven.role != "primary":
+                    # the shard was handed over while this apply waited
+                    # out the quiesce: applying here would ack a batch
+                    # the successor still holds pending — break the
+                    # barrier instead; the trainers' retry re-pushes
+                    # (deduped by the snapshotted watermarks) and the
+                    # SUCCESSOR's barrier applies the batch exactly once
+                    raise RuntimeError(
+                        "sync barrier broken: shard handed over "
+                        "mid-batch; retry the step at the new primary")
+                return self._apply_pending_impl(n_contrib, replicated)
+        return self._apply_pending_impl(n_contrib, replicated)
+
+    def _apply_pending_impl(self, n_contrib=None, replicated=False):
         with self._pending_lock:
             pending, self._pending = self._pending, {}
             # distinct trainers whose gradients are actually summed into
@@ -482,7 +596,11 @@ class ParameterServer:
                 if b > self._sync_applied.get(t, -1):
                     self._sync_applied[t] = b
             self._sync_pending_from.clear()
-        n_contrib = len(contributors) or self._sync_barrier.live_parties
+            if n_contrib is None:
+                n_contrib = len(contributors) or \
+                    self._sync_barrier.live_parties
+            if not replicated and self._haven is not None and pending:
+                self._haven.record_sync_apply(n_contrib)
         for n, g in pending.items():
             with self._lock(n):
                 self._optim[n].dense(self._dense[n],
@@ -559,15 +677,22 @@ class ParameterServer:
                     self._pending.clear()
                     self._sync_pending_from.clear()
                     self._sync_barrier.reset()
+                    if self._haven is not None:
+                        # fluid-haven: the discard must replicate — the
+                        # backup's replayed pending holds the broken
+                        # batch's pushes, and without the reset the
+                        # retried batch would dedup against them and
+                        # the copies would silently diverge
+                        self._haven.record(_haven.SYNC_RESET_RECORD, {})
             return ("err", "sync barrier broken (a trainer died or timed "
                            "out mid-batch); batch discarded, barrier "
                            "reset — retry the step")
         return ("ok", None)
 
     # -- checkpoint (reference checkpoint_notify -> save block) ------------
-    def _shard_path(self, dirname):
-        return os.path.join(
-            dirname, f"pserver_{self.endpoint.replace(':', '_')}.npz")
+    def _shard_path(self, dirname, endpoint=None):
+        ep = endpoint or self.endpoint
+        return os.path.join(dirname, f"pserver_{ep.replace(':', '_')}.npz")
 
     def _h_save(self, dirname):
         """Snapshot values AND optimizer state (accumulators + config) so
@@ -581,7 +706,25 @@ class ParameterServer:
         `ark.verify_checkpoint` can prove the shard intact. When
         `dirname` is a checkpoint stage dir (trainer-driven
         `save_checkpoint(shard_saver=...)`), the shard commits as part of
-        the same all-or-nothing serial."""
+        the same all-or-nothing serial.
+
+        fluid-haven: on a replicating server the snapshot is taken under
+        a brief quiesce (in-flight mutators drain, new ones are held) so
+        the shard is a consistent cut, and the sidecar manifest is
+        tagged with the replication watermark (`haven_seq`) + fencing
+        epoch — the checkpoint names exactly which prefix of the update
+        stream it contains."""
+        if self._haven is not None:
+            with self._haven.quiesce():
+                st = self._haven.status()
+                return self._save_impl(
+                    dirname,
+                    haven_seq=(st["head_seq"] if st["role"] == "primary"
+                               else st["applied_seq"]),
+                    haven_epoch=st["epoch"], haven_role=st["role"])
+        return self._save_impl(dirname)
+
+    def _save_impl(self, dirname, **sidecar_extra):
         import json
 
         os.makedirs(dirname, exist_ok=True)
@@ -610,18 +753,24 @@ class ParameterServer:
         with ark_ckpt.atomic_file(path) as f:
             np.savez(f, __meta__=np.array(json.dumps(meta)), **arrays)
         ark_ckpt.write_sidecar_manifest(path, endpoint=self.endpoint,
-                                        kind="pserver_shard")
+                                        kind="pserver_shard",
+                                        **sidecar_extra)
         return ("ok", path)
 
-    def recover(self, dirname) -> "ParameterServer":
+    def recover(self, dirname,
+                shard_endpoint: Optional[str] = None) -> "ParameterServer":
         """Restore this server's shard from `dirname` (written by a prior
         save on the SAME endpoint). Values, sparse tables, and optimizer
         accumulators all come back, so resumed training continues the
         exact update sequence — the crash-restart leg of the reference's
-        checkpoint/notify protocol (trainer.py:986 resume path)."""
+        checkpoint/notify protocol (trainer.py:986 resume path).
+
+        fluid-haven: `shard_endpoint` names the PEER whose shard file to
+        load — how a promoted former-backup (or a fresh process on a new
+        port) recovers the checkpoint its dead primary wrote."""
         import json
 
-        path = self._shard_path(dirname)
+        path = self._shard_path(dirname, endpoint=shard_endpoint)
         # checksum gate BEFORE deserializing: a torn/bit-rotted shard is
         # refused loudly, never half-loaded (no sidecar = pre-ark shard,
         # loaded as before)
@@ -647,9 +796,82 @@ class ParameterServer:
                                            m["attrs"])
         return self
 
-    def _h_restore(self, dirname):
-        self.recover(dirname)
+    def _h_restore(self, dirname, shard_endpoint=None):
+        self.recover(dirname, shard_endpoint=shard_endpoint)
+        if self._haven is not None:
+            # the shard state changed out-of-band: the update log can no
+            # longer bring the backup current — force a full resync
+            self._haven.mark_resync()
         return ("ok", sorted(self._dense) + sorted(self._sparse))
+
+    # -- fluid-haven: replication / election / handoff ---------------------
+    def start_replication(self, backup_endpoint: str, lease_s: float = 2.0,
+                          window: int = 512, stall_timeout_s: float = 5.0
+                          ) -> "ParameterServer":
+        """Arm this server as the PRIMARY of a replicated pair: every
+        applied update is forwarded to `backup_endpoint` as a
+        sequence-numbered record; the forwarder's batches double as the
+        primary's lease renewal on the backup. The first batch performs
+        a full snapshot sync, so the backup may start empty."""
+        from ..haven import HavenState
+        if self._haven is None:
+            self._haven = HavenState(self, role="primary", lease_s=lease_s,
+                                     window=window,
+                                     stall_timeout_s=stall_timeout_s)
+        self._haven.lease_s = float(lease_s)
+        self._haven.start_replication(backup_endpoint)
+        return self
+
+    def start_standby(self, lease_s: float = 2.0,
+                      auto_promote: bool = True) -> "ParameterServer":
+        """Arm this server as a standby BACKUP: it replays the primary's
+        record stream, serves bounded-stale reads, redirects writes, and
+        (with `auto_promote`) promotes itself when the primary's lease
+        expires. A handover target passes `auto_promote=False` so a torn
+        handover can never elect two primaries."""
+        from ..haven import HavenState
+        if self._haven is None:
+            self._haven = HavenState(self, role="backup", lease_s=lease_s)
+        self._haven.lease_s = float(lease_s)
+        self._haven.start_standby(auto_promote=auto_promote)
+        return self
+
+    def handover(self, new_endpoint: str, timeout: float = 30.0) -> dict:
+        """Planned live shard handoff to a fresh standby process (see
+        HavenState.handover): drain, snapshot+tail stream, lease flip,
+        retire — zero failed trainer pushes across the flip."""
+        from ..haven import HavenState
+        if self._haven is None:   # solo server moving hosts
+            self._haven = HavenState(self, role="primary")
+        return self._haven.handover(new_endpoint, timeout=timeout)
+
+    def _h_haven_role(self):
+        if self._haven is None:
+            return ("ok", {"role": "solo", "epoch": -1,
+                           "endpoint": self.endpoint,
+                           "primary": self.endpoint})
+        return ("ok", self._haven.status())
+
+    def _ensure_standby(self, auto_promote=True):
+        if self._haven is None:
+            # a bare server adopted by a primary arms itself on first
+            # contact (lease_s refreshed from the primary's batches)
+            self.start_standby(auto_promote=auto_promote)
+        return self._haven
+
+    def _h_haven_replicate(self, records, epoch, primary, lease_s=2.0):
+        return self._ensure_standby().replay(records, epoch, primary,
+                                             lease_s)
+
+    def _h_haven_sync(self, snapshot, lease_s=2.0):
+        return self._ensure_standby().install_snapshot(snapshot,
+                                                       lease_s=lease_s)
+
+    def _h_haven_promote(self, epoch, backup=None, predecessor=None):
+        hv = self._ensure_standby(auto_promote=False)
+        hv.promote(kind="handover", epoch=epoch, backup=backup,
+                   predecessor=predecessor)
+        return ("ok", {"epoch": hv.epoch, "role": hv.role})
 
     def _h_stats(self):
         return ("ok", {"dense": sorted(self._dense),

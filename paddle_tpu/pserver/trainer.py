@@ -20,6 +20,7 @@ distributed op becomes a host phase around `exe.run`:
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -66,10 +67,18 @@ class AsyncPSTrainer:
         self.program = program or transpiler.get_trainer_program()
         # fluid-wire: the transpiler config's comm_quant rides into the
         # client so pserver pushes/pulls travel quantized (negotiated per
-        # endpoint; legacy servers degrade to raw)
+        # endpoint; legacy servers degrade to raw).
+        # fluid-haven: config.haven_replicas ({primary: [backup, ...]})
+        # arms read AND write failover — pushes are seq-tagged so a
+        # replay at a promoted backup dedups server-side instead of
+        # double-applying.
+        replicas = getattr(transpiler.config, "haven_replicas", None)
         self.client = PSClient(
             transpiler._pserver_endpoints,
-            comm_quant=getattr(transpiler.config, "comm_quant", None))
+            comm_quant=getattr(transpiler.config, "comm_quant", None),
+            replicas=replicas,
+            dedup_pushes=replicas is not None,
+            trainer_id=transpiler._trainer_id)
         self.trainer_id = transpiler._trainer_id
         # tables sharing any ids feed must share one uniq/remap (a fed ids
         # var can only hold ONE remapping) — group them transitively
@@ -330,21 +339,50 @@ class SyncPSTrainer(AsyncPSTrainer):
         user_outs = outs[: len(fetch_list)]
         grads = outs[len(fetch_list):]
 
-        # 3. send: accumulate-only pushes, tagged with this trainer's
-        # batch id (stable across retries — servers reject duplicates)
-        self.client.push_grads_sync(self._dense_grads_by_ep(grads),
-                                    batch_id=self._batch_id,
-                                    trainer_id=self.trainer_id,
-                                    session=self._session)
-
-        # 4. ... then the per-batch barrier on EVERY server (each counts
-        # all trainers); returning means the aggregated update is applied.
-        # The arrival is tagged with this trainer's id so an eviction of
-        # THIS trainer discounts it (ark liveness). Only a successful
-        # apply advances the batch id: a barrier error propagates and the
-        # user's retry re-runs THIS batch id.
-        self.client.sync_apply(self.t._pserver_endpoints,
-                               trainer_id=self.trainer_id)
+        # 3+4. send (accumulate-only pushes tagged with this trainer's
+        # batch id, stable across retries — servers reject duplicates),
+        # then the per-batch barrier on EVERY server; returning means
+        # the aggregated update is applied. The arrival is tagged with
+        # this trainer's id so an eviction of THIS trainer discounts it
+        # (ark liveness). Only a successful apply advances the batch id.
+        #
+        # fluid-haven (replicas configured): a primary death or a
+        # broken barrier mid-batch is retried INTERNALLY under the same
+        # batch id — pushes dedup server-side, the client re-resolves
+        # the promoted primary, and the barrier fires on the survivor —
+        # so a shard failover is not a trainer-visible failure. Without
+        # replicas the legacy contract holds: the error propagates and
+        # the caller owns the retry.
+        failover = bool(self.client.replicas)
+        deadline = time.monotonic() + \
+            (2.0 * self.client.failover_s if failover else 0.0)
+        while True:
+            try:
+                self.client.push_grads_sync(self._dense_grads_by_ep(grads),
+                                            batch_id=self._batch_id,
+                                            trainer_id=self.trainer_id,
+                                            session=self._session)
+                self.client.sync_apply(self.t._pserver_endpoints,
+                                       trainer_id=self.trainer_id)
+                break
+            except (ConnectionError, EOFError, OSError, RuntimeError) as e:
+                # the retriable RuntimeErrors are the two documented
+                # retry-the-step contracts: the server's barrier-reset
+                # reply and the client's failed primary re-resolution —
+                # anything else propagates
+                retriable = isinstance(e, (ConnectionError, EOFError,
+                                           OSError)) or \
+                    "sync barrier broken" in str(e) or \
+                    "NotPrimary" in str(e)
+                if not failover or not retriable \
+                        or time.monotonic() >= deadline:
+                    raise
+                if _flags.get_flag("observe"):
+                    _metrics.counter(
+                        "pserver_sync_step_retries_total",
+                        "sync batches retried across a shard failover "
+                        "or broken barrier").inc()
+                time.sleep(0.1)
         self._batch_id += 1
         if _flags.get_flag("observe"):
             _note_step_health(user_outs, grads)

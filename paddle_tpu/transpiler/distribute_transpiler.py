@@ -90,6 +90,14 @@ class DistributeTranspilerConfig:
     # pushes / sparse rows as codec-tagged payloads with client-side
     # error feedback (wire/codec.py; negotiated — legacy servers get raw)
     comm_quant = None
+    # fluid-haven replicated PS plane: {primary_endpoint: [backup, ...]}.
+    # When set, the PS trainers' client fails over READS AND WRITES to a
+    # promoted backup (pushes are seq-tagged so replays dedup
+    # server-side), and a primary SIGKILL costs lease-time + one retry
+    # budget instead of wedging training. The pair itself is armed on
+    # the server side via ParameterServer.start_replication() /
+    # start_standby() (docs/FAULT_TOLERANCE.md §Replicated PS plane).
+    haven_replicas = None
 
 
 class DistributeTranspiler:

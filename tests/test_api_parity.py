@@ -21,14 +21,12 @@ def test_every_reference_export_present():
     assert not gaps, f"reference exports missing from paddle_tpu: {gaps}"
 
 
-def test_no_export_raises_on_use():
-    """A present-but-raising export must never count as parity (round-3
-    verdict: a stub ModelAverage shipped inside a 100% claim). The
-    detector flags any export whose body or __init__ starts with an
-    unconditional raise."""
-    from tools.api_parity import stub_symbols, _body_is_stub
+def test_stub_detector_self_check():
+    """The detector itself needs no reference tree: it must catch the
+    exact round-3 failure shape (an __init__ that is one unconditional
+    raise) and pass a guarded constructor."""
+    from tools.api_parity import _body_is_stub
 
-    # self-check: the detector catches the exact round-3 failure shape
     class Stub:
         def __init__(self):
             raise NotImplementedError("later")
@@ -41,5 +39,15 @@ def test_no_export_raises_on_use():
 
     assert _body_is_stub(Stub.__init__)
     assert not _body_is_stub(Guarded.__init__)
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_no_export_raises_on_use():
+    """A present-but-raising export must never count as parity (round-3
+    verdict: a stub ModelAverage shipped inside a 100% claim). The
+    audit walks the reference __all__ lists, so it needs the reference
+    tree mounted — a clean container reports a skip, not a permanent
+    failure."""
+    from tools.api_parity import stub_symbols
 
     assert stub_symbols() == []

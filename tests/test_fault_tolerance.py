@@ -595,7 +595,8 @@ def test_chaos_drill_cli(tmp_path):
     import subprocess
     import sys
     for scenario in ("flaky_rpc", "quant_flaky_rpc", "pserver_kill",
-                     "ckpt_crash", "sync_evict"):
+                     "ckpt_crash", "sync_evict", "ps_primary_kill",
+                     "ps_handover"):
         # ckpt_crash records no RPC/executor spans of its own — passing
         # --trace-out there pins the root-drill-span fallback that keeps
         # the merge's spans_in > 0 gate satisfied for ANY scenario

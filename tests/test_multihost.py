@@ -15,6 +15,79 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Multi-process collective DP needs a jax backend that implements
+# multiprocess computations. Recent CPU jaxlibs refuse with
+# "Multiprocess computations aren't implemented on the CPU backend", so
+# a clean CPU-only container must report these tests as SKIPPED (env
+# prerequisite absent), not as a permanent known-failure. The probe runs
+# the minimal 2-process rendezvous + one jitted reduction over the
+# global mesh — exactly the capability the tests exercise. It is
+# evaluated LAZILY at test start (never at collection: a `pytest
+# --collect-only` or an unrelated-subset run must not pay a 2-process
+# jax boot) and cached, so only the first selected test pays it.
+_MP_PROBE = """
+import os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=os.environ["COORD"],
+                           num_processes=2,
+                           process_id=int(os.environ["RANK"]))
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+mesh = Mesh(np.array(jax.devices()), ("dp",))
+x = jax.device_put(jnp.ones((len(jax.devices()),)),
+                   NamedSharding(mesh, PartitionSpec("dp")))
+out = jax.jit(lambda a: a.sum(),
+              out_shardings=NamedSharding(mesh, PartitionSpec()))(x)
+jax.block_until_ready(out)
+print("MP_OK", flush=True)
+"""
+
+_mp_supported_cache = []
+
+
+def _multiprocess_backend_supported() -> bool:
+    if _mp_supported_cache:
+        return _mp_supported_cache[0]
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    procs = []
+    try:
+        for rank in range(2):
+            env = dict(os.environ, COORD=f"127.0.0.1:{port}",
+                       RANK=str(rank), JAX_PLATFORMS="cpu",
+                       XLA_FLAGS="--xla_force_host_platform_device_count=2")
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", _MP_PROBE], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True))
+        ok = True
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out = ""
+            ok = ok and p.returncode == 0 and "MP_OK" in out
+    except OSError:
+        ok = False
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    _mp_supported_cache.append(ok)
+    return ok
+
+
+def _require_multiprocess_backend():
+    if not _multiprocess_backend_supported():
+        pytest.skip("jax backend does not implement multiprocess "
+                    "computations (CPU-only container); needs real "
+                    "devices or a multiprocess-capable jaxlib")
+
 WORKER = """
 import os, sys
 import jax
@@ -108,10 +181,12 @@ def _run_collective_dp(tmp_path, world):
 
 
 def test_two_process_collective_dp(tmp_path):
+    _require_multiprocess_backend()
     _run_collective_dp(tmp_path, 2)
 
 
 def test_four_process_collective_dp(tmp_path):
     """P4 scaled a notch (round-4 verdict item 9): a 4-process world over
     8 global devices, identical loss trajectories on every rank."""
+    _require_multiprocess_backend()
     _run_collective_dp(tmp_path, 4)

@@ -42,6 +42,19 @@ Scenarios (all seed-deterministic through ark.chaos):
                   failed requests (failovers metered; p99 degrades and
                   is recorded), the dead replica's lease expires, and
                   the survivors show zero steady-state recompiles
+    ps_primary_kill  fluid-haven: SIGKILL the PRIMARY of a replicated
+                  pserver pair mid-training, under async AND sync PS;
+                  PASS = training completes with zero trainer-visible
+                  failures, the no-fault replicated run is BIT-IDENTICAL
+                  to the unreplicated baseline, final loss lands inside
+                  the bounded-loss band, the promotion is metered, and
+                  the surviving backup's flight recorder shows the
+                  promotion event
+    ps_handover   fluid-haven: planned live shard handoff to a fresh
+                  standby under continuous training load; PASS = zero
+                  failed trainer steps, exactly ONE lease-holder at
+                  every sampled instant, exact update continuity across
+                  the flip, and the handover promotion metered
 
 `--trace-out DIR` (any scenario): every participating process writes its
 chrome trace file into DIR (`trace_<process>.json`) and the drill merges
@@ -93,9 +106,14 @@ def _fresh_world(seed, n_servers=2, lr=0.1):
     return servers, tr, loss, batch
 
 
-def _build_world(eps, seed, lr=0.1):
+def _build_world(eps, seed, lr=0.1, sync=False, haven_replicas=None):
     """Trainer half of the 2-layer FC world, against endpoints that may
-    live in ANOTHER process (the health_alerts drill's ps_worker)."""
+    live in ANOTHER process (the health_alerts drill's ps_worker).
+    `sync=True` builds the pserver-runtime sync world (SyncPSTrainer);
+    `haven_replicas` arms the client's primary re-resolution + tagged
+    pushes for the fluid-haven drills."""
+    from paddle_tpu.pserver import SyncPSTrainer
+
     np.random.seed(seed)
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup), fluid.unique_name.guard():
@@ -106,13 +124,19 @@ def _build_world(eps, seed, lr=0.1):
         loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
         fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
     main.random_seed = startup.random_seed = seed
-    t = fluid.DistributeTranspiler()
+    cfg = fluid.DistributeTranspilerConfig()
+    if sync:
+        cfg.runtime = "pserver"
+    if haven_replicas:
+        cfg.haven_replicas = dict(haven_replicas)
+    t = fluid.DistributeTranspiler(cfg)
     t.transpile(trainer_id=0, program=main, pservers=eps, trainers=1,
-                sync_mode=False)
+                sync_mode=sync)
     scope = fluid.Scope()
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup, scope=scope)
-    tr = AsyncPSTrainer(t, exe, program=main, scope=scope)
+    cls = SyncPSTrainer if sync else AsyncPSTrainer
+    tr = cls(t, exe, program=main, scope=scope)
     tr.init_params()
     rng = np.random.RandomState(seed + 1)
     w_true = rng.randn(8, 2).astype(np.float32)
@@ -686,8 +710,197 @@ def drill_replica_kill(seed, workdir, trace_out=None):
         fluid.set_flag("observe", False)
 
 
+def _haven_pair(lease_s=1.0, auto_promote=True):
+    from paddle_tpu.pserver import ParameterServer
+
+    backup = ParameterServer("127.0.0.1:0").start()
+    backup.start_standby(lease_s=lease_s, auto_promote=auto_promote)
+    primary = ParameterServer("127.0.0.1:0").start()
+    primary.start_replication(backup.endpoint, lease_s=lease_s)
+    return primary, backup
+
+
+def _final_params(tr):
+    return {p: np.array(tr.client.get_param(spec["endpoint"], p))
+            for p, spec in tr.t.param_specs.items()}
+
+
+def drill_ps_primary_kill(seed, workdir, trace_out=None):
+    """fluid-haven: SIGKILL the PRIMARY of a replicated pserver pair
+    mid-training, under async and sync PS (see module docstring)."""
+    from paddle_tpu.observe import flight as obs_flight
+
+    N1, N2 = 10, 14
+    for mode in ("async", "sync"):
+        sync = mode == "sync"
+        fluid.set_flag("observe", True)
+        obs_metrics.default_registry().reset()
+
+        # 1) unreplicated baseline: the loss band AND the bit-identity
+        # reference for the no-fault replicated run
+        from paddle_tpu.pserver import ParameterServer
+        solo = ParameterServer("127.0.0.1:0").start()
+        try:
+            tr, loss, batch = _build_world(solo.endpoint, seed, sync=sync)
+            ref = _run_steps(tr, loss, batch, N1 + N2)
+            ref_params = _final_params(tr)
+            tr.close()
+        finally:
+            solo.stop()
+
+        # 2) replicated, no fault: replication must be PASSIVE —
+        # bit-identical to the unreplicated baseline
+        primary, backup = _haven_pair(lease_s=1.0)
+        try:
+            tr, loss, batch = _build_world(
+                primary.endpoint, seed, sync=sync,
+                haven_replicas={primary.endpoint: [backup.endpoint]})
+            clean = _run_steps(tr, loss, batch, N1 + N2)
+            _check(clean == ref,
+                   f"[{mode}] no-fault replicated losses bit-identical "
+                   f"to unreplicated baseline")
+            got = _final_params(tr)
+            _check(all(np.array_equal(got[p], ref_params[p])
+                       for p in ref_params),
+                   f"[{mode}] no-fault replicated params bit-identical")
+            tr.close()
+        finally:
+            primary.stop()
+            backup.stop()
+
+        # 3) replicated + SIGKILL'd primary mid-run
+        obs_metrics.default_registry().reset()
+        primary, backup = _haven_pair(lease_s=1.0)
+        try:
+            tr, loss, batch = _build_world(
+                primary.endpoint, seed, sync=sync,
+                haven_replicas={primary.endpoint: [backup.endpoint]})
+            losses = _run_steps(tr, loss, batch, N1)
+            victim = chaos.kill_server(primary)
+            print(f"  [{mode}] SIGKILL'd primary {victim} at step {N1}")
+            t0 = time.monotonic()
+            losses += _run_steps(tr, loss, batch, N2)   # raises = FAIL
+            print(f"  [{mode}] {N2} post-kill steps completed "
+                  f"(first blip absorbed in {time.monotonic() - t0:.1f}s "
+                  f"of tail)")
+            _check(np.isfinite(losses).all(),
+                   f"[{mode}] all losses finite, zero trainer-visible "
+                   f"failures")
+            band = np.mean(ref[-6:]) * 1.25 + 0.05
+            _check(np.mean(losses[-6:]) < band,
+                   f"[{mode}] final loss {np.mean(losses[-6:]):.4f} "
+                   f"inside the bounded-loss band (<{band:.4f})")
+            _check(backup._haven.role == "primary",
+                   f"[{mode}] backup promoted itself (epoch "
+                   f"{backup._haven.epoch})")
+            promoted = obs_metrics.default_registry().get(
+                "ps_promotions_total")
+            _check(promoted is not None and promoted.total() >= 1,
+                   f"[{mode}] promotion metered")
+            promos = obs_flight.get_flight().events("haven_promotion")
+            _check(any(e.get("endpoint") == backup.endpoint
+                       for e in promos),
+                   f"[{mode}] surviving backup's flight recorder shows "
+                   f"the promotion event")
+            fo = obs_metrics.default_registry().get(
+                "pserver_client_primary_failovers_total")
+            print(f"  [{mode}] client primary failovers: "
+                  f"{fo.total() if fo else 0:.0f}")
+            tr.close()
+        finally:
+            fluid.set_flag("observe", False)
+            primary.stop()
+            backup.stop()
+
+
+def drill_ps_handover(seed, workdir, trace_out=None):
+    """fluid-haven: planned live shard handoff under continuous async
+    training load (see module docstring)."""
+    import threading
+
+    from paddle_tpu.pserver import ParameterServer
+
+    fluid.set_flag("observe", True)
+    obs_metrics.default_registry().reset()
+    primary, backup = _haven_pair(lease_s=1.0)
+    fresh = ParameterServer("127.0.0.1:0").start()
+    fresh.start_standby(lease_s=1.0, auto_promote=False)
+    servers = [primary, backup, fresh]
+    try:
+        tr, loss, batch = _build_world(
+            primary.endpoint, seed,
+            haven_replicas={primary.endpoint: [backup.endpoint,
+                                               fresh.endpoint]})
+        stop = threading.Event()
+        losses, failures = [], []
+
+        def train_loop():
+            while not stop.is_set():
+                try:
+                    l, = tr.step(batch(), fetch_list=[loss])
+                    losses.append(float(np.asarray(l).reshape(-1)[0]))
+                except Exception as e:          # noqa: BLE001
+                    failures.append(repr(e))
+
+        # lease-holder sampler: at EVERY sampled instant at most one of
+        # the three servers may be ACCEPTING writes. (`accepting`, not
+        # bare role: during the promote RPC round-trip the predecessor
+        # still carries the primary role but its mutator gate is held —
+        # it cannot acknowledge a write, so the successor is the sole
+        # lease-holder the moment it processes the promote.)
+        violations = []
+
+        def sample_roles():
+            while not stop.is_set():
+                acc = [s._haven.status()["accepting"] if s._haven
+                       else True for s in servers]
+                if sum(acc) > 1:
+                    violations.append(list(acc))
+                time.sleep(0.005)
+
+        t_train = threading.Thread(target=train_loop, daemon=True)
+        t_roles = threading.Thread(target=sample_roles, daemon=True)
+        t_train.start()
+        t_roles.start()
+        time.sleep(1.0)
+        pre_steps = len(losses)
+        res = primary.handover(fresh.endpoint)
+        print(f"  handover complete: successor {res['successor']} at "
+              f"epoch {res['epoch']}, seq {res['seq']}")
+        time.sleep(1.5)
+        stop.set()
+        t_train.join(timeout=30)
+        t_roles.join(timeout=5)
+        _check(not failures,
+               f"zero failed trainer steps across the handoff "
+               f"({len(losses)} steps; first failure: "
+               f"{failures[0] if failures else None})")
+        _check(len(losses) > pre_steps,
+               f"training continued against the successor "
+               f"({len(losses) - pre_steps} post-flip steps)")
+        _check(not violations,
+               f"exactly one lease-holder at every sampled instant "
+               f"({violations[:3] if violations else 'clean'})")
+        _check(fresh._haven.role == "primary"
+               and primary._haven.role == "retired",
+               "roles flipped: successor primary, predecessor retired")
+        promoted = obs_metrics.default_registry().get(
+            "ps_promotions_total")
+        _check(promoted is not None
+               and promoted.value(kind="handover") >= 1,
+               "handover promotion metered")
+        _check(np.isfinite(losses).all(), "all losses finite")
+        tr.close()
+    finally:
+        fluid.set_flag("observe", False)
+        for s in servers:
+            s.stop()
+
+
 SCENARIOS = {
     "flaky_rpc": drill_flaky_rpc,
+    "ps_primary_kill": drill_ps_primary_kill,
+    "ps_handover": drill_ps_handover,
     "replica_kill": drill_replica_kill,
     "quant_flaky_rpc": drill_quant_flaky_rpc,
     "pserver_kill": drill_pserver_kill,
