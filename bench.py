@@ -531,6 +531,26 @@ def haven_subprocess():
     return rec
 
 
+def quorum_subprocess():
+    """fluid-quorum numbers (tools/quorum_bench.py — the arbiter plane
+    is host TCP + json): lease-renewal overhead on the sync-PS step of
+    a quorum-armed haven pair vs the PR 12 haven baseline, interleaved
+    min-of-medians (acceptance: <= 2% — the renewal is one tiny
+    majority fan-out per lease/3 on its own thread), and the
+    asymmetric-partition failover blip — the wall-time gap in trainer
+    step completions while the primary fences, steps down, and the
+    majority-side backup wins the election — which must land inside
+    the 2-lease + retry/resolve budget (quorum_failover_ok)."""
+    rec, rc = _tool_json("quorum_bench.py", "quorum bench", timeout=420)
+    if rec is None:
+        return {"quorum_renewal_overhead_pct": -1.0,
+                "quorum_failover_blip_ms": 0.0,
+                "quorum_failover_ok": False}
+    if rc:
+        rec["quorum_bench_rc"] = rc
+    return rec
+
+
 def planner_subprocess(peak_tflops, measured_mfu):
     """fluid-planner agreement segment (tools/paddle_plan.py, CPU
     subprocess — the plan is a static walk, no device work): predicted
@@ -997,6 +1017,13 @@ def main():
     _obs.flight.set_stage("haven_subprocess")
     havenrec = haven_subprocess()
     note(**havenrec)
+    # fluid-quorum: lease-renewal overhead on the sync-PS step (<=2%
+    # acceptance vs the haven baseline) + the asymmetric-partition
+    # failover blip vs the lease+retry budget (quorum_failover_ok)
+    _PARTIAL["extra"]["failure_stage"] = "quorum_subprocess"
+    _obs.flight.set_stage("quorum_subprocess")
+    quorumrec = quorum_subprocess()
+    note(**quorumrec)
     # the headline pair is drift-sensitive through the dev tunnel, and
     # the noise is ONE-SIDED: a stall can only lower a reading below the
     # true device rate, never raise it (the device cannot run faster

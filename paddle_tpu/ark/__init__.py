@@ -20,6 +20,7 @@ from .checkpoint import (CheckpointConfig, CheckpointError,  # noqa: F401
                          save_checkpoint, verify_checkpoint,
                          verify_sidecar, write_sidecar_manifest)
 from .retry import NO_RETRY, RetryPolicy  # noqa: F401
-from .liveness import EvictingBarrier, LeaseTable  # noqa: F401
+from .liveness import (EvictingBarrier, LeaseTable,  # noqa: F401
+                       QuorumLeaseTable)
 from .heartbeat import HeartbeatThread  # noqa: F401
 from . import chaos  # noqa: F401

@@ -27,11 +27,12 @@ requests and replies.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import random
 import threading
 import time
-from typing import Optional, Tuple
+from typing import Optional, Set, Tuple
 
 logger = logging.getLogger(__name__)
 
@@ -45,6 +46,47 @@ def _rpc():
 
 def _is_server_thread() -> bool:
     return threading.current_thread().name.startswith("psconn@")
+
+
+# -- actor identity (fluid-quorum / NetPartition) -------------------------
+#
+# A partition is defined between ACTORS (logical processes), not
+# sockets. The sender of a message is identified, in order: an explicit
+# thread-local set via `acting_as(endpoint)` (how a pooled client
+# thread inherits its owner's identity), else the `...@<endpoint>`
+# suffix every server-owned thread already carries (psconn@/qconn@
+# connection threads, haven-fwd@/haven-monitor@/quorum-renew@ loops).
+# Threads with neither (a trainer's own threads) are the anonymous
+# actor None, which partition rules can target with the "*" wildcard.
+
+_thread_actor = threading.local()
+
+
+def set_thread_actor(endpoint: Optional[str]) -> None:
+    _thread_actor.endpoint = endpoint
+
+
+@contextlib.contextmanager
+def acting_as(endpoint: Optional[str]):
+    """Attribute every message this thread sends inside the context to
+    `endpoint` — how a client owned by server X marks its outbound
+    traffic as X's even from a shared worker pool."""
+    prev = getattr(_thread_actor, "endpoint", None)
+    _thread_actor.endpoint = endpoint
+    try:
+        yield
+    finally:
+        _thread_actor.endpoint = prev
+
+
+def current_actor() -> Optional[str]:
+    ep = getattr(_thread_actor, "endpoint", None)
+    if ep is not None:
+        return ep
+    name = threading.current_thread().name
+    if "@" in name:
+        return name.rsplit("@", 1)[1]
+    return None
 
 
 class ChaosMonkey:
@@ -155,6 +197,111 @@ class ChaosMonkey:
 
     def total_injected(self) -> int:
         return sum(self.injected.values())
+
+
+class NetPartition:
+    """Pair-wise DIRECTIONAL network partition over the rpc fault hook
+    (fluid-quorum). A rule `(src, dst)` blackholes every request the
+    actor `src` initiates toward the listening endpoint `dst`: the wire
+    bytes are consumed at send time, so the sender believes it sent and
+    then waits out its own deadline — exactly what a partition looks
+    like from inside a process. Because the cut is at request
+    initiation, the reply path of a blocked request needs no separate
+    rule (the request never arrived); reply-only loss — the genuinely
+    ambiguous failure — stays `ChaosMonkey(side="server")`'s job.
+
+    `src` is an actor name (see `current_actor()`: an explicit
+    `acting_as` scope, else the thread's `...@<endpoint>` suffix); `"*"`
+    matches any actor including the anonymous one. `dst` is the target's
+    listening endpoint as the client dials it; `"*"` matches all.
+
+    `p < 1.0` drops each matched message by an independent draw from one
+    `random.Random(seed)` stream — a flaky (not severed) link, replayed
+    byte-identically per seed. Default p=1.0 is a full cut.
+
+        with NetPartition(seed=7) as net:
+            net.isolate(primary_ep, backup_ep)       # both directions
+            net.block(primary_ep, arbiter2_ep)       # one direction
+            ...
+            net.heal()                               # all traffic flows
+    """
+
+    def __init__(self, seed: int = 0, p: float = 1.0):
+        self.rng = random.Random(seed)
+        self.p = float(p)
+        self._rules: Set[Tuple[str, str]] = set()
+        self._lock = threading.Lock()
+        self._installed = False
+        self.dropped = 0
+
+    # -- rules -----------------------------------------------------------
+    def block(self, src: str, dst: str) -> "NetPartition":
+        with self._lock:
+            self._rules.add((src, dst))
+        return self
+
+    def isolate(self, a: str, b: str) -> "NetPartition":
+        """Cut the pair in both request directions."""
+        return self.block(a, b).block(b, a)
+
+    def heal(self, src: Optional[str] = None,
+             dst: Optional[str] = None) -> None:
+        """Remove matching rules (no args: remove ALL — full heal)."""
+        with self._lock:
+            if src is None and dst is None:
+                self._rules.clear()
+            else:
+                self._rules = {(s, d) for s, d in self._rules
+                               if not ((src is None or s == src)
+                                       and (dst is None or d == dst))}
+
+    def blocks(self, src: Optional[str], dst: str) -> bool:
+        with self._lock:
+            for s, d in self._rules:
+                if (s == "*" or s == src) and (d == "*" or d == dst):
+                    return True
+        return False
+
+    # -- hook ------------------------------------------------------------
+    def _hook(self, direction: str, sock, data: Optional[bytes]):
+        if direction != "send" or data is None:
+            return data
+        try:
+            host, port = sock.getpeername()[:2]
+        except OSError:
+            return data
+        dst = f"{host}:{port}"
+        if not self.blocks(current_actor(), dst):
+            return data
+        with self._lock:
+            if self.p < 1.0 and self.rng.random() >= self.p:
+                return data
+            self.dropped += 1
+        logger.debug("partition: dropped %d bytes %s -> %s", len(data),
+                     current_actor(), dst)
+        return None   # blackhole: the caller believes it sent
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "NetPartition":
+        if self._installed:
+            return self
+        rpc = _rpc()
+        if rpc.get_fault_hook() is not None:
+            raise RuntimeError("another fault hook is already installed")
+        rpc.set_fault_hook(self._hook)
+        self._installed = True
+        return self
+
+    def stop(self) -> None:
+        if self._installed:
+            _rpc().set_fault_hook(None)
+            self._installed = False
+
+    def __enter__(self) -> "NetPartition":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
 
 
 # -- process-level faults -------------------------------------------------

@@ -34,7 +34,9 @@ class HeartbeatThread:
 
     def __init__(self, client=None, endpoints: Sequence[str] = (),
                  trainer_id: int = 0, session=None, lease_s: float = 3.0,
-                 interval: Optional[float] = None, beat=None):
+                 interval: Optional[float] = None, beat=None,
+                 quorum=None, quorum_resource: Optional[str] = None,
+                 quorum_holder=None):
         if beat is None and client is None:
             raise ValueError("HeartbeatThread needs a client+endpoints "
                              "pair or a beat callable")
@@ -45,6 +47,21 @@ class HeartbeatThread:
         self.lease_s = float(lease_s)
         self.interval = float(interval) if interval else self.lease_s / 3.0
         self._beat = beat
+        # fluid-quorum opt-in: each renewal round ALSO asserts this
+        # member's own lease at the arbiter group (resource/holder
+        # default to the QuorumLeaseTable convention `member:<id>` /
+        # `str(id)`; fleet replicas pass their own), so a lease table
+        # with quorum backing can tell "member died" from "my link to
+        # the member died". Best-effort like every beat — and ordered
+        # AFTER the member beats with a failure backoff, so a degraded
+        # quorum can never starve the real renewals past the lease.
+        self.quorum = quorum
+        self.quorum_resource = quorum_resource or f"member:{trainer_id}"
+        self.quorum_holder = (str(quorum_holder)
+                              if quorum_holder is not None
+                              else str(trainer_id))
+        self._quorum_lease = None
+        self._quorum_retry_at = 0.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -80,6 +97,12 @@ class HeartbeatThread:
                             endpoint="custom")
                 logger.debug("custom heartbeat failed: %s", e)
                 return 0
+            finally:
+                # quorum lease AFTER the member beat: a degraded
+                # arbiter group (blackholed nodes eating their full
+                # deadlines) must not delay the renewal the lease-table
+                # owner is actually waiting for
+                self._quorum_beat()
         futs = {ep: self.client._pool.submit(
                     self.client.heartbeat, ep, trainer_id=self.trainer_id,
                     session=self.session, lease_s=self.lease_s)
@@ -101,7 +124,42 @@ class HeartbeatThread:
                                  trainer_id=self.trainer_id,
                                  error=type(e).__name__)
                 logger.debug("heartbeat to %s failed: %s", ep, e)
+        self._quorum_beat()   # after the member beats (see __init__)
         return ok
+
+    def _quorum_beat(self) -> None:
+        """Renew (or first campaign for) this member's own quorum
+        lease. `quorum_holder` identifies the member, so a
+        `QuorumLeaseTable` can verify identity; a failed round is
+        swallowed, metered, and BACKED OFF for a lease period — on the
+        minority side of a partition, renew+campaign rounds wait out
+        blackholed arbiters' deadlines, and repeating that every beat
+        would stall the loop's real renewals (the false eviction this
+        mechanism exists to prevent). The lease simply expires at the
+        arbiters in the meantime, which is the honest signal."""
+        import time as _time
+
+        if self.quorum is None or _time.monotonic() < self._quorum_retry_at:
+            return
+        try:
+            lease = self._quorum_lease
+            if lease is not None and self.quorum.renew(lease):
+                return
+            self._quorum_lease = self.quorum.campaign(
+                self.quorum_resource, self.quorum_holder, self.lease_s,
+                max_rounds=1)
+            if self._quorum_lease is None:
+                self._quorum_retry_at = _time.monotonic() + self.lease_s
+        except Exception as e:   # noqa: BLE001 — best-effort by contract
+            from .. import flags as _flags
+            from ..observe import metrics as _metrics
+            self._quorum_retry_at = _time.monotonic() + self.lease_s
+            if _flags.get_flag("observe"):
+                _metrics.counter(
+                    "ark_heartbeat_misses_total",
+                    "heartbeat renewals that failed").inc(
+                        endpoint="quorum")
+            logger.debug("quorum member lease renewal failed: %s", e)
 
     def _loop(self):
         while not self._stop.wait(self.interval):

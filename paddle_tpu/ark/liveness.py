@@ -82,6 +82,98 @@ class LeaseTable:
                     for t, (s, exp, l) in self._leases.items()}
 
 
+class QuorumLeaseTable(LeaseTable):
+    """fluid-quorum opt-in backing for membership leases: before a
+    member whose LOCAL lease lapsed is reported expired, the arbiter
+    group gets a second opinion. A member that lost its path to the
+    table's owner (an asymmetric partition: replica <-> router cut,
+    trainer <-> pserver cut) but still renews its own quorum lease at
+    the arbiters is ALIVE — evicting it would shrink the world for a
+    link failure, the exact false positive the crash-stop model could
+    not exclude.
+
+    Members renew their quorum lease themselves (`HeartbeatThread`'s
+    `quorum=` option — resource `<prefix><member id>`, holder = the
+    member id). Arbiter answers are cached for `status_ttl_s` so the
+    eviction poll loop (~10 Hz while a barrier waits) does not hammer
+    the group. Without a quorum client this IS a plain `LeaseTable`,
+    bit for bit."""
+
+    def __init__(self, quorum=None, resource_prefix: str = "member:",
+                 status_ttl_s: float = 1.0):
+        super().__init__()
+        self.quorum = quorum
+        self.resource_prefix = str(resource_prefix)
+        self.status_ttl_s = float(status_ttl_s)
+        self._q_cache: Dict[object, Tuple[float, bool]] = {}
+        self._q_inflight: set = set()
+
+    def _quorum_probe(self, key) -> bool:
+        try:
+            rec = self.quorum.holder(f"{self.resource_prefix}{key}")
+            live = bool(rec and str(rec.get("holder")) == str(key))
+        except Exception:   # noqa: BLE001 — unreachable arbiters add no
+            live = False    # liveness evidence; the local verdict stands
+        with self._lock:
+            self._q_cache[key] = (time.monotonic(), live)
+            self._q_inflight.discard(key)
+            while len(self._q_cache) > 4096:
+                self._q_cache.pop(next(iter(self._q_cache)))
+        return live
+
+    def _quorum_live(self, member, blocking: bool = True) -> bool:
+        """The arbiters' opinion of `member`, cached `status_ttl_s`.
+        `blocking=False` (the router's per-request dispatch path) never
+        waits on an arbiter fan-out: a stale cached verdict is served
+        while ONE background probe per member refreshes it, and an
+        unknown member reads False (plain-table behavior) until the
+        first probe lands — the holder() deadline must not become a
+        recurring p99 spike on the serving hot path. Eviction decisions
+        (`expired()`, a poll-loop context) stay blocking."""
+        if self.quorum is None:
+            return False
+        key = self._key(member)
+        now = time.monotonic()
+        with self._lock:
+            hit = self._q_cache.get(key)
+            if hit is not None and now - hit[0] < self.status_ttl_s:
+                return hit[1]
+            if not blocking:
+                stale = hit[1] if hit is not None else False
+                if key not in self._q_inflight:
+                    self._q_inflight.add(key)
+                    threading.Thread(
+                        target=self._quorum_probe, args=(key,),
+                        daemon=True,
+                        name=f"quorum-probe:{key}").start()
+                return stale
+        return self._quorum_probe(key)
+
+    def expired(self) -> Iterable:
+        return [t for t in super().expired() if not self._quorum_live(t)]
+
+    def live(self) -> Iterable:
+        """Locally-live members PLUS locally-expired ones the arbiters
+        still vouch for (the fleet router's membership view: a replica
+        the router cannot hear from directly stays a member; whether it
+        can take traffic is the readiness poll's separate verdict).
+        Non-blocking by design — this sits on the router's dispatch
+        path (see `_quorum_live`)."""
+        out = list(super().live())
+        if self.quorum is not None:
+            out += [t for t in super().expired()
+                    if self._quorum_live(t, blocking=False)]
+        return out
+
+    def snapshot(self) -> Dict[int, Dict]:
+        snap = super().snapshot()
+        if self.quorum is not None:
+            for t, rec in snap.items():
+                if not rec["live"]:
+                    rec["quorum_live"] = self._quorum_live(t)
+        return snap
+
+
 class EvictingBarrier:
     """A cyclic barrier over `parties` members whose effective party
     count shrinks when members are evicted (and grows back on readmit).

@@ -55,8 +55,19 @@ class ReplicaServer(_wire.HardCutServer):
                  replica_id: Optional[str] = None,
                  router_endpoint: Optional[str] = None,
                  lease_s: float = 3.0,
-                 simulate_device_ms: float = 0.0):
-        """`simulate_device_ms` is a REHEARSAL-RIG knob (CPU containers,
+                 simulate_device_ms: float = 0.0,
+                 quorum=None,
+                 quorum_member_prefix: str = "fleet-member:"):
+        """`quorum` (fluid-quorum, a `QuorumClient`) makes this
+        replica's membership partition-safe: each heartbeat round also
+        renews its OWN lease at the arbiter group under
+        `<quorum_member_prefix><replica_id>` with the replica id as the
+        holder — exactly what a router armed with
+        `RouterConfig(quorum=..., quorum_member_prefix=...)` verifies,
+        so a replica that lost its path to the router (but not to the
+        arbiters) is not falsely evicted from membership.
+
+        `simulate_device_ms` is a REHEARSAL-RIG knob (CPU containers,
         often single-core): it sleeps that long per served request,
         standing in for the TPU device time a real replica spends off
         the host CPU. It is what lets the multi-replica loadgen measure
@@ -75,6 +86,8 @@ class ReplicaServer(_wire.HardCutServer):
         # ceiling and the scaling drill would measure nothing
         self._device_lock = threading.Lock()
         self.endpoint = endpoint
+        self.quorum = quorum
+        self.quorum_member_prefix = str(quorum_member_prefix)
         self._heartbeat: Optional[HeartbeatThread] = None
         self._router_pool: Optional[_wire.ConnPool] = None
 
@@ -88,8 +101,12 @@ class ReplicaServer(_wire.HardCutServer):
         if self.router_endpoint:
             self._router_pool = _wire.ConnPool(self.router_endpoint,
                                               max_idle=1)
-            self._heartbeat = HeartbeatThread(beat=self._beat_router,
-                                              lease_s=self.lease_s)
+            self._heartbeat = HeartbeatThread(
+                beat=self._beat_router, lease_s=self.lease_s,
+                quorum=self.quorum,
+                quorum_resource=(f"{self.quorum_member_prefix}"
+                                 f"{self.replica_id}"),
+                quorum_holder=self.replica_id)
             # synchronous first beat: membership exists before the first
             # request could be routed here
             self._heartbeat.beat_once()

@@ -43,7 +43,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
-from ..ark.liveness import LeaseTable
+from ..ark.liveness import LeaseTable, QuorumLeaseTable
 from ..ark.retry import RetryPolicy
 from ..observe import metrics as _metrics
 from ..pserver import rpc as _rpc
@@ -67,6 +67,15 @@ class RouterConfig:
     # fluid-pulse opt-in: the router's own health plane (requires the
     # observe flag) with a fleet_membership readiness check
     pulse_port: Optional[int] = None
+    # fluid-quorum opt-in: a QuorumClient against the arbiter group.
+    # Membership leases become quorum-backed (ark.QuorumLeaseTable): a
+    # replica partitioned from the router but still renewing its own
+    # member lease at the arbiters (HeartbeatThread(quorum=...)) is not
+    # evicted from membership — readiness polling, which requires a
+    # live router->replica path anyway, still gates dispatch. None
+    # keeps the plain LeaseTable, bit for bit.
+    quorum: Optional[object] = None
+    quorum_member_prefix: str = "fleet-member:"
 
 
 class FleetError(ServeError):
@@ -125,7 +134,10 @@ class FleetRouter(_wire.HardCutServer):
             max_attempts=3, base_delay=0.01, max_delay=0.25)
         self._lock = threading.RLock()
         self._members: Dict[str, _Member] = {}
-        self._lease = LeaseTable()
+        self._lease = (QuorumLeaseTable(
+            quorum=self.config.quorum,
+            resource_prefix=self.config.quorum_member_prefix)
+            if self.config.quorum is not None else LeaseTable())
         self._rr = 0
         # committed fleet version per model (set by swap); gates
         # readiness so a stale replica can never serve mixed versions

@@ -33,5 +33,6 @@ from .log import ReplicationStalled, UpdateLog  # noqa: F401
 from .replication import (CONTROL_CMDS, COUNTED_CMDS,  # noqa: F401
                           DISPATCH_RECORDED_CMDS, LAG_UPDATES_METRIC,
                           LAG_US_METRIC, MUTATING_CMDS, PROMOTIONS_METRIC,
-                          READ_CMDS, RECORDED_CMDS, SYNC_APPLY_RECORD,
-                          SYNC_RESET_RECORD, HavenState, Replicator)
+                          READ_CMDS, RECORDED_CMDS, STEP_DOWNS_METRIC,
+                          SYNC_APPLY_RECORD, SYNC_RESET_RECORD, HavenState,
+                          Replicator)

@@ -116,8 +116,22 @@ class UpdateLog:
             return self._acked
 
     def lag(self) -> int:
+        """Records the backup has NOT caught up on. While a resync is
+        pending (`needs_resync`, not degraded) the acked watermark
+        cannot express the true backlog — `resume()` advances it at the
+        snapshot CUT, before the snapshot lands — so the lag is floored
+        at 1 until `rebase()` confirms the install. Without this floor,
+        `lag() == 0` (the universal "backup is current" probe: tests,
+        the handover drain, the lag gauges) is transiently TRUE during
+        the in-flight `haven_sync` RPC of a fresh pair's first full
+        sync, a race a loaded box hits for real. A DEGRADED log still
+        reports 0: recording is suspended on purpose there (solo
+        availability mode), which is idle, not backlog."""
         with self._cond:
-            return self._head - self._acked
+            base = self._head - self._acked
+            if self.needs_resync and not self._degraded:
+                return max(base, 1)
+            return base
 
     def oldest_unacked_age_s(self) -> float:
         with self._cond:

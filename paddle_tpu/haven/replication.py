@@ -34,18 +34,35 @@ handovers carry a fencing **epoch** — a record stream from a lower
 epoch than the receiver's is answered with a redirect naming the real
 primary, so a deposed primary steps down instead of split-braining.
 
-Failure model — CRASH-STOP. The loss bound (<= the in-flight window)
-and the single-acceptor guarantee are proven for process death and for
-planned handover, which is what the drills model. An asymmetric network
-PARTITION between a live primary and an auto-promoting backup is
-outside this model: the isolated backup promotes on lease expiry while
-the primary keeps serving clients that can still reach it, and every
+Failure model — CRASH-STOP by default, PARTITION-TOLERANT with a
+quorum. A bare 2-node pair cannot distinguish "peer died" from "peer
+unreachable": the isolated backup promotes on lease expiry while the
+primary keeps serving clients that can still reach it, and every
 update the deposed primary acknowledges solo is discarded when the
-partition heals and the first contact fences it (`haven_fenced`). A
-two-node pair cannot distinguish "peer died" from "peer unreachable";
-closing that window needs a quorum arbiter (the reference repo parked
-this on etcd) — until then, run `start_standby(auto_promote=False)`
-plus operator-driven `promote()` where partitions are a real risk.
+partition heals and the first contact fences it (`haven_fenced`) —
+run `start_standby(auto_promote=False)` there. Arming a fluid-quorum
+arbiter group (`quorum_endpoints=` on both members) upgrades the
+failure model, and `auto_promote=True` becomes the safe default:
+
+- the standby promotes ONLY on a quorum-granted lease (a strict
+  majority of arbiters at a fencing epoch above every epoch any
+  earlier majority granted), so a replication-link partition alone can
+  never split-brain the pair;
+- the primary renews its quorum lease at lease/3 and FAILS CLOSED: a
+  renew round that cannot reach a majority fences the write path
+  (mutators HELD, not acked) immediately, and local lease expiry steps
+  the node down to an unsynced standby BEFORE the arbiters would let a
+  rival win — at every observable point at most one member accepts
+  writes, with margin (arbiter-side expiry trails the holder's local
+  expiry);
+- a deposed primary's `has_synced` is cleared at step-down: its solo
+  tail (updates acked after the partition cut replication — bounded by
+  the in-flight window) is divergent history, so healing rejoins it as
+  a resyncing standby and nothing the backup acknowledged is ever
+  lost.
+
+`tools/chaos_drill.py --scenario ps_partition` proves the claim under
+async and sync PS with `ark.chaos.NetPartition`.
 """
 
 from __future__ import annotations
@@ -123,6 +140,7 @@ SYNC_RESET_RECORD = "__sync_reset__"
 LAG_UPDATES_METRIC = "ps_replication_lag_updates"
 LAG_US_METRIC = "ps_replication_lag_us"
 PROMOTIONS_METRIC = "ps_promotions_total"
+STEP_DOWNS_METRIC = "ps_step_downs_total"
 
 
 class HavenState:
@@ -154,6 +172,16 @@ class HavenState:
         self._held = False
         self._replicator: Optional[Replicator] = None
         self._monitor: Optional[threading.Thread] = None
+        # fluid-quorum (arm_quorum): the arbiter client, the shard's
+        # lease resource, the held lease + its renewal thread, and the
+        # fail-closed fence (mutators held while a renew round cannot
+        # reach a majority)
+        self.quorum = None
+        self.resource: Optional[str] = None
+        self.quorum_lease_s: Optional[float] = None
+        self._qlease = None
+        self._renewer: Optional[threading.Thread] = None
+        self._fenced = False
         self._stop = threading.Event()
         # test hook: raise at a named handover cut point ("pre_promote" /
         # "post_promote") to drill the torn-handoff contract
@@ -184,7 +212,11 @@ class HavenState:
         COUNTED_CMDS)."""
         entered = False
         with self._gate:
-            while self._held and cmd in COUNTED_CMDS:
+            # _fenced: a quorum-armed primary whose renew round failed
+            # holds (not fails) mutators — a transient blip resumes
+            # them, a real deposition flips the role and the redirect
+            # verdict below releases them toward the new primary
+            while (self._held or self._fenced) and cmd in COUNTED_CMDS:
                 self._gate.wait(timeout=1.0)
             verdict = self._verdict(cmd)
             if verdict is None and cmd in COUNTED_CMDS:
@@ -205,7 +237,7 @@ class HavenState:
         same held/counted contract as a COUNTED command, so a quiesced
         cut never observes it mid-write."""
         with self._gate:
-            while self._held:
+            while self._held or self._fenced:
                 self._gate.wait(timeout=1.0)
             self._active += 1
         try:
@@ -239,7 +271,11 @@ class HavenState:
         A degraded log (backup gone past the stall timeout) drops the
         record and flags the pair for a full resync — availability over
         replication once there is no failover target left."""
-        if self.role != "primary" or self._replicator is None:
+        # local snapshot: a concurrent step-down/demotion may null the
+        # forwarder between the check and the kick (kicking a stopped
+        # forwarder is a harmless event set)
+        rep = self._replicator
+        if self.role != "primary" or rep is None:
             return
         was = self.log.degraded
         if self.log.append(cmd, payload) is None and not was:
@@ -248,7 +284,7 @@ class HavenState:
             logger.warning("haven %s: replication degraded (backup %s "
                            "unresponsive) — recording suspended until "
                            "resync", self.server.endpoint, self.peer)
-        self._replicator.kick()
+        rep.kick()
 
     def record_sync_apply(self, n_contrib: int) -> None:
         """Called from inside `_apply_pending` (under the pending lock)
@@ -260,8 +296,131 @@ class HavenState:
         """State changed out-of-band (a restore): the log can no longer
         bring the backup up to date — force a full snapshot sync."""
         self.log.degrade()
-        if self._replicator is not None:
-            self._replicator.kick()
+        rep = self._replicator
+        if rep is not None:
+            rep.kick()
+
+    # -- quorum (fluid-quorum) ---------------------------------------------
+    def arm_quorum(self, client, resource: str,
+                   lease_s: Optional[float] = None) -> "HavenState":
+        """Attach a fluid-quorum arbiter group: elections for this shard
+        now require a majority-granted lease on `resource`, and this
+        node fails closed when it cannot renew. Both members of a pair
+        must name the SAME resource. No quorum armed = the exact PR 12
+        crash-stop behavior, bit for bit."""
+        self.quorum = client
+        self.resource = str(resource)
+        self.quorum_lease_s = float(lease_s) if lease_s else self.lease_s
+        return self
+
+    def _quorum_acquire(self, kind: str) -> Optional[int]:
+        """Campaign for the shard lease; returns the fencing epoch on a
+        majority grant (and arms the renewal loop), None when the
+        election is lost. Raises QuorumUnavailable when no arbiter
+        answered at all."""
+        lease = self.quorum.campaign(self.resource, self.server.endpoint,
+                                     self.quorum_lease_s)
+        if lease is None:
+            return None
+        with self._state_lock:
+            self._qlease = lease
+        self._set_fenced(False)
+        self._ensure_renewer()
+        _flight.note("quorum_lease_acquired",
+                     endpoint=self.server.endpoint,
+                     resource=self.resource, epoch=lease.epoch, via=kind)
+        return lease.epoch
+
+    def _set_fenced(self, fenced: bool, reason: str = "") -> None:
+        with self._gate:
+            if self._fenced == fenced:
+                return
+            self._fenced = fenced
+            self._gate.notify_all()
+        if fenced:
+            logger.warning("haven %s: FENCED (%s) — mutators held until "
+                           "the quorum lease renews or expires",
+                           self.server.endpoint, reason)
+            _flight.note("haven_fence", endpoint=self.server.endpoint,
+                         reason=reason)
+        else:
+            _flight.note("haven_unfence", endpoint=self.server.endpoint)
+
+    def _ensure_renewer(self) -> None:
+        if self._renewer is None or not self._renewer.is_alive():
+            self._renewer = threading.Thread(
+                target=self._renew_loop, daemon=True,
+                name=f"quorum-renew@{self.server.endpoint}")
+            self._renewer.start()
+
+    def _renew_loop(self) -> None:
+        """Lease renewal at lease/3. The loop follows the LEASE, not
+        the role (a just-elected standby holds its grant for a moment
+        before promote() flips the role — exiting on role would leave
+        the new primary's lease to silently expire); it ends when the
+        lease is dropped (step-down, demotion, handover, resign).
+
+        Fail closed on the primary: the FIRST renew round that cannot
+        reach a majority fences the write path; recovery before local
+        expiry unfences; local expiry steps the node down (the
+        arbiters' own expiry — which started later — is what then lets
+        a rival win, so the fence always precedes the rival's grant)."""
+        while not self._stop.is_set():
+            with self._state_lock:
+                lease = self._qlease
+            if lease is None:
+                return
+            interval = max(lease.lease_s / 3.0, 0.05)
+            if self._stop.wait(interval):
+                return
+            with self._state_lock:
+                lease = self._qlease
+            if lease is None:
+                return
+            try:
+                ok = self.quorum.renew(lease)
+            except Exception:   # noqa: BLE001 — unreachable == failed
+                ok = False
+            if ok:
+                if self._fenced:
+                    self._set_fenced(False)
+                continue
+            if self.role == "primary":
+                self._set_fenced(True, reason="quorum renew failed")
+                if not lease.live:
+                    self._quorum_step_down("lease_expired")
+                    return
+            elif not lease.live:
+                # a non-primary holder (the adopt->promote window never
+                # closed, e.g. promote() raised): drop the dead lease
+                with self._state_lock:
+                    if self._qlease is lease:
+                        self._qlease = None
+                return
+
+    def _quorum_step_down(self, reason: str) -> None:
+        """Deposed (or presumed deposed): stop accepting writes for
+        good, become an UNSYNCED standby — `has_synced` is cleared
+        because any update acknowledged solo since the last backup ack
+        is divergent history; the new primary's first contact performs
+        a full resync (the healed-partition rejoin contract)."""
+        with self._state_lock:
+            if self.role != "primary":
+                return
+            self.role = "backup"
+            self.primary_ep = None   # learned from the winner's sync
+            self.has_synced = False
+            self._qlease = None
+        logger.warning("haven %s: STEPPED DOWN (%s) — resyncing standby",
+                       self.server.endpoint, reason)
+        _flight.note("haven_step_down", endpoint=self.server.endpoint,
+                     reason=reason)
+        _metrics.counter(
+            STEP_DOWNS_METRIC,
+            "quorum-armed primaries that stepped down").inc(reason=reason)
+        self._set_fenced(False)
+        self._stop_replicator()
+        self._ensure_monitor()
 
     # -- backup: replay ----------------------------------------------------
     def replay(self, records: List[Tuple[int, str, dict]], epoch: int,
@@ -329,7 +488,9 @@ class HavenState:
         _flight.note("haven_demotion", endpoint=self.server.endpoint,
                      new_primary=primary, epoch=epoch)
         self.role = "backup"
+        self._qlease = None   # the rival's higher epoch fenced our lease
         self._stop_replicator()
+        self._set_fenced(False)
         self._ensure_monitor()
 
     # -- snapshots ---------------------------------------------------------
@@ -428,9 +589,25 @@ class HavenState:
                 backup: Optional[str] = None,
                 predecessor: Optional[str] = None) -> bool:
         """Standby -> primary. `kind` is "lease_expiry" (self-election on
-        a dead primary) or "handover" (the `predecessor` handed us the
-        crown, with `epoch` fenced one above its own and optionally the
+        a dead primary), "quorum" (the monitor won a majority-granted
+        lease), or "handover" (the `predecessor` handed us the crown,
+        with `epoch` fenced one above its own and optionally the
         surviving `backup` to replicate to)."""
+        if self.quorum is not None:
+            with self._state_lock:
+                have = self._qlease is not None and self._qlease.live
+            if not have:
+                # every road to primary goes through the arbiters: a
+                # handover target (the predecessor resigned first) and
+                # an operator promote() both campaign here; a monitor
+                # election arrives with the lease already adopted
+                won = self._quorum_acquire(kind)
+                if won is None:
+                    raise RuntimeError(
+                        f"promote({kind}): quorum election lost for "
+                        f"{self.resource!r} — a rival holds the lease "
+                        f"or this side has no majority")
+                epoch = max(int(epoch or 0), won)
         with self._state_lock:
             if self.role == "primary":
                 return False
@@ -461,14 +638,35 @@ class HavenState:
         return True
 
     def _monitor_loop(self):
+        from ..quorum import QuorumUnavailable
+
         poll = max(self.lease_s / 3.0, 0.05)
         while not self._stop.wait(poll):
             if self.role != "backup" or not self.auto_promote \
                     or not self.has_synced:
                 continue
-            if "primary" in self.primary_lease.expired():
+            if "primary" not in self.primary_lease.expired():
+                continue
+            if self.quorum is None:
                 self.promote(kind="lease_expiry")
                 return
+            # quorum-gated election: promote ONLY on a majority grant.
+            # A rejection ("held": the primary is alive to a majority —
+            # only OUR link to it is down; or no majority: WE are the
+            # minority side) fails closed and keeps polling — the
+            # split-brain the crash-stop model could not exclude.
+            old_primary = self.primary_ep
+            try:
+                won = self._quorum_acquire("lease_expiry")
+            except QuorumUnavailable:
+                continue
+            if won is None:
+                continue
+            # adopt the deposed primary as OUR backup: when the
+            # partition heals, the forwarder's first contact resyncs it
+            # (its has_synced was cleared at step-down)
+            self.promote(kind="quorum", epoch=won, backup=old_primary)
+            return
 
     def _ensure_monitor(self):
         """(Re)arm the promotion monitor: the loop exits after a
@@ -488,6 +686,18 @@ class HavenState:
         return self
 
     def start_replication(self, backup_endpoint: str) -> "HavenState":
+        if self.quorum is not None:
+            with self._state_lock:
+                have = self._qlease is not None and self._qlease.live
+            if not have:
+                won = self._quorum_acquire("bootstrap")
+                if won is None:
+                    raise RuntimeError(
+                        f"start_replication: quorum election lost for "
+                        f"{self.resource!r} — another primary holds the "
+                        f"lease (resign it or wait out its expiry)")
+                with self._state_lock:
+                    self.epoch = max(self.epoch, won)
         self.role = "primary"
         self.peer = backup_endpoint
         self._stop_replicator()
@@ -507,19 +717,30 @@ class HavenState:
     def status(self) -> dict:
         with self._gate:
             # the observable lease-holder property: a primary whose gate
-            # is HELD (mid-handover quiesce) cannot acknowledge a write
-            # — at most one member of a group is ever `accepting`
-            accepting = self.role == "primary" and not self._held
-        return {"role": self.role, "epoch": self.epoch,
-                "endpoint": self.server.endpoint,
-                "primary": self.current_primary(),
-                "peer": self.peer,
-                "accepting": accepting,
-                "head_seq": self.log.head_seq,
-                "acked_seq": self.log.acked_seq,
-                "applied_seq": self.applied_seq,
-                "lag": self.log.lag(),
-                "degraded": self.log.degraded}
+            # is HELD (mid-handover quiesce) or FENCED (quorum renew
+            # failing) cannot acknowledge a write — at most one member
+            # of a group is ever `accepting`
+            accepting = self.role == "primary" and not self._held \
+                and not self._fenced
+            fenced = self._fenced
+        out = {"role": self.role, "epoch": self.epoch,
+               "endpoint": self.server.endpoint,
+               "primary": self.current_primary(),
+               "peer": self.peer,
+               "accepting": accepting,
+               "fenced": fenced,
+               "head_seq": self.log.head_seq,
+               "acked_seq": self.log.acked_seq,
+               "applied_seq": self.applied_seq,
+               "lag": self.log.lag(),
+               "degraded": self.log.degraded}
+        if self.quorum is not None:
+            with self._state_lock:
+                ql = self._qlease
+            out["quorum"] = {"resource": self.resource,
+                            "lease_epoch": ql.epoch if ql else 0,
+                            "lease_live": bool(ql and ql.live)}
+        return out
 
     # -- handover ----------------------------------------------------------
     def handover(self, new_endpoint: str, timeout: float = 30.0) -> dict:
@@ -554,9 +775,17 @@ class HavenState:
         try:
             with self.quiesce():
                 # 2. drain the existing backup through head (bounded)
-                if self._replicator is not None:
-                    self._replicator.kick()
-                    while self.log.lag() > 0 and not self.log.degraded:
+                rep = self._replicator
+                if rep is not None:
+                    rep.kick()
+                    # a needs_resync pair skips the drain: the old
+                    # backup is being replaced wholesale by the
+                    # successor's full snapshot anyway, and the
+                    # forwarder's own resync would block on THIS
+                    # quiesce (lag now honestly reports >=1 while a
+                    # resync is pending)
+                    while self.log.lag() > 0 and not self.log.degraded \
+                            and not self.log.needs_resync:
                         if time.monotonic() - t0 > timeout:
                             raise RuntimeError(
                                 "handover: backup failed to drain the "
@@ -568,9 +797,40 @@ class HavenState:
                     raise RuntimeError("haven test fault: pre_promote")
                 client._call(new_endpoint, "haven_sync", snapshot=snap,
                              lease_s=self.lease_s)
-                reply = client._call(
-                    new_endpoint, "haven_promote", epoch=self.epoch + 1,
-                    backup=old_backup, predecessor=self.server.endpoint)
+                if self.quorum is not None:
+                    # hand the arbiters over too, under the still-held
+                    # gate: resign so the successor's campaign (inside
+                    # its haven_promote) is not rejected as "held". A
+                    # crash between resign and promote self-heals — the
+                    # next renew round re-asserts this node's lease at
+                    # its persisted epoch (the restart-renew rule).
+                    with self._state_lock:
+                        ql, self._qlease = self._qlease, None
+                    if ql is not None:
+                        self.quorum.resign(ql)
+                try:
+                    reply = client._call(
+                        new_endpoint, "haven_promote",
+                        epoch=self.epoch + 1, backup=old_backup,
+                        predecessor=self.server.endpoint)
+                except BaseException:
+                    if self.quorum is not None:
+                        # the successor never took the crown but we
+                        # already resigned: re-campaign NOW (our
+                        # persisted epoch makes us the favorite) or
+                        # fail closed — a primary without a quorum
+                        # lease must not keep accepting writes
+                        won = None
+                        try:
+                            won = self._quorum_acquire("handover_abort")
+                        except Exception:   # noqa: BLE001
+                            pass
+                        if won is None:
+                            self._quorum_step_down("handover_abort")
+                        else:
+                            with self._state_lock:
+                                self.epoch = max(self.epoch, won)
+                    raise
                 # 5. retire IMMEDIATELY after the promote ack, under the
                 # still-held gate — no statement may intervene, so there
                 # is no instant where both this server and the successor
@@ -598,6 +858,19 @@ class HavenState:
         if self._monitor is not None:
             self._monitor.join(timeout=2.0)
             self._monitor = None
+        if self._renewer is not None:
+            self._renewer.join(timeout=2.0)
+            self._renewer = None
+        # NOTE: close() is also the SIGKILL analog (server.stop() calls
+        # it), so the held quorum lease is deliberately NOT resigned —
+        # a killed primary's lease must expire at the arbiters, exactly
+        # the window the failover budget prices in. Planned exits hand
+        # over or resign explicitly.
+        if self.quorum is not None:
+            try:
+                self.quorum.close()
+            except Exception:   # noqa: BLE001
+                pass
 
 
 class Replicator:

@@ -36,6 +36,12 @@ paper's per-task health story. Three pieces:
                              keep landing — the backup stopped
                              keeping up (self-clears when the ack
                              watermark moves again)
+  * ``quorum_loss``          fluid-quorum: a HELD lease cannot renew
+                             against a strict majority of arbiters —
+                             this holder is fenced (writes held) and
+                             will step down at local expiry unless the
+                             quorum comes back (self-clears on re-grant
+                             or successful renew)
   * ``wire_compression_collapse`` on-wire ratio fell to half of the
                              session's established ratio
 
@@ -492,6 +498,36 @@ class ReplicationStallDetector(Detector):
             engine.clear(self)
 
 
+class QuorumLossDetector(Detector):
+    """fluid-quorum: any resource whose `quorum_lease_ok` gauge sits at
+    0 — the holder believes it owns the lease but its renew rounds
+    cannot reach a strict majority of arbiters. While this fires the
+    holder's write path is fenced; if it persists to local expiry the
+    holder steps down. Self-clears the moment a renew or a fresh grant
+    lands (the client writes the gauge back to 1)."""
+
+    name = "quorum_loss"
+    series = "quorum_lease_ok"
+
+    def check(self, engine, now):
+        reg = _metrics.default_registry()
+        g = reg.get("quorum_lease_ok")
+        if g is None:
+            engine.clear(self)
+            return
+        for labels, v in g.items():
+            if v == 0.0:
+                engine.fire(
+                    self, observed=0.0, threshold=1.0,
+                    message=f"quorum lease "
+                            f"{labels.get('resource', '?')!r} cannot "
+                            f"renew against a majority — holder fenced, "
+                            f"step-down at local expiry",
+                    detail=dict(labels))
+                return
+        engine.clear(self)
+
+
 class CompressionCollapseDetector(Detector):
     """fluid-wire ratio collapse: the windowed raw/on-wire byte ratio
     fell to half of the best ratio this session established. A session
@@ -556,6 +592,9 @@ DEFAULT_WATCHES = (
      {"cmd": "push_grads_sync"}),
     ("pserver_server_requests_total", "ps_push_serves",
      {"cmd": "push_sparse_grad"}),
+    # fluid-quorum: renew verdicts (1 ok / 0 failing while held) — the
+    # quorum_loss detector's evidence series for alert postmortems
+    ("quorum_lease_ok", "quorum_lease_ok", None),
 )
 
 
@@ -678,6 +717,7 @@ class HealthEngine:
                                       "fleet_failovers",
                                       window_s=15.0, threshold=8.0),
                     ReplicationStallDetector(),
+                    QuorumLossDetector(),
                     CompressionCollapseDetector()):
             self.add_detector(det)
         self._ensure_watches()   # arms only the not-yet-armed specs

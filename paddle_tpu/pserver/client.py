@@ -66,7 +66,9 @@ class PSClient:
                  read_only: bool = False,
                  dedup_pushes: bool = False,
                  trainer_id: int = 0,
-                 failover_s: float = 20.0):
+                 failover_s: float = 20.0,
+                 quorum_endpoints: Optional[Sequence[str]] = None,
+                 quorum_resources: Optional[Dict[str, str]] = None):
         # fluid-fleet: a serving replica's sparse read path holds a
         # PSClient purely to PULL rows — read_only=True makes a mutating
         # call (a stray push_grad from a serving process would corrupt
@@ -103,6 +105,17 @@ class PSClient:
         # backup's lease-expiry promotion before giving up.
         self._primaries: Dict[str, str] = {}
         self.failover_s = float(failover_s)
+        # fluid-quorum: when the shard's election runs through an
+        # arbiter group, the client can ask the ARBITERS who rules
+        # (`quorum_resources` maps a logical endpoint to its lease
+        # resource; the holder id is the primary's endpoint by
+        # convention) — failover then finds a primary living at an
+        # endpoint no configured candidate names, without waiting out
+        # the haven_role poll grid. Lazy: no arbiter RPC until the
+        # first failover needs one.
+        self._quorum_eps = list(quorum_endpoints or ())
+        self._quorum_resources = dict(quorum_resources or {})
+        self._quorum_client = None
         # fluid-haven exactly-once for BARRIERLESS pushes: when armed,
         # push_grad(s)/push_sparse_grad carry (trainer, seq, session) so
         # the server's async watermark makes them replay-safe — the rule
@@ -246,6 +259,14 @@ class PSClient:
                    *self.replicas.get(endpoint, ())]:
             if ep not in cands:
                 cands.append(ep)
+        hinted = self._quorum_holder(endpoint)
+        if hinted and hinted not in cands:
+            # the arbiters' view leads the poll: the quorum holder is
+            # the primary by construction (it may live at an endpoint
+            # no configured candidate names), but it is still VERIFIED
+            # below via haven_role — a stale minority view must not
+            # route writes on its own
+            cands.insert(0, hinted)
         deadline = time.monotonic() + (self.failover_s if wait else 0.0)
         while True:
             best, saw_standby, hints = None, False, []
@@ -295,6 +316,25 @@ class PSClient:
                     or time.monotonic() >= deadline:
                 return False
             time.sleep(0.25)
+
+    def _quorum_holder(self, endpoint) -> Optional[str]:
+        """Ask the arbiter group who holds `endpoint`'s shard lease
+        (None without a quorum route, on a minority view, or when no
+        arbiter answers)."""
+        resource = self._quorum_resources.get(endpoint)
+        if resource is None or not self._quorum_eps:
+            return None
+        if self._quorum_client is None:
+            from ..quorum import QuorumClient
+            with self._lock:
+                if self._quorum_client is None:
+                    self._quorum_client = QuorumClient(self._quorum_eps,
+                                                       deadline_s=1.0)
+        try:
+            rec = self._quorum_client.holder(resource)
+        except Exception:   # noqa: BLE001 — resolution is best-effort
+            return None
+        return rec["holder"] if rec else None
 
     def _call(self, endpoint, cmd, _deadline=..., **payload):
         """One logical RPC with retry/backoff/deadline; `_deadline=...`
@@ -862,6 +902,11 @@ class PSClient:
 
     def close(self):
         self._pool.shutdown(wait=False)
+        if self._quorum_client is not None:
+            try:
+                self._quorum_client.close()
+            except Exception:
+                pass
         with self._lock:
             for s in self._socks.values():
                 try:

@@ -805,34 +805,74 @@ class ParameterServer:
         return ("ok", sorted(self._dense) + sorted(self._sparse))
 
     # -- fluid-haven: replication / election / handoff ---------------------
+    def _arm_quorum(self, quorum_endpoints, quorum_resource,
+                    quorum_lease_s, lease_s):
+        """fluid-quorum opt-in shared by both haven roles: build the
+        arbiter client (attributed to THIS server for chaos partition
+        rules) and attach it as the shard's election source. Both
+        members of a pair must name the same resource."""
+        from ..quorum import QuorumClient
+        lease = float(quorum_lease_s or lease_s)
+        client = QuorumClient(
+            list(quorum_endpoints), actor=self.endpoint,
+            # short per-node deadline: a renewal round must resolve well
+            # inside lease/3 even with one arbiter blackholed
+            deadline_s=max(0.25, min(1.0, lease / 4.0)))
+        self._haven.arm_quorum(client, quorum_resource or "ps-shard-0",
+                               lease_s=lease)
+
     def start_replication(self, backup_endpoint: str, lease_s: float = 2.0,
-                          window: int = 512, stall_timeout_s: float = 5.0
+                          window: int = 512, stall_timeout_s: float = 5.0,
+                          quorum_endpoints=None,
+                          quorum_resource: Optional[str] = None,
+                          quorum_lease_s: Optional[float] = None
                           ) -> "ParameterServer":
         """Arm this server as the PRIMARY of a replicated pair: every
         applied update is forwarded to `backup_endpoint` as a
         sequence-numbered record; the forwarder's batches double as the
         primary's lease renewal on the backup. The first batch performs
-        a full snapshot sync, so the backup may start empty."""
+        a full snapshot sync, so the backup may start empty.
+
+        `quorum_endpoints` (fluid-quorum, a 3/5-node arbiter group)
+        upgrades the pair's failure model to partition-tolerant: this
+        primary must win — and keep renewing — a majority-granted lease
+        on `quorum_resource`, failing closed when it cannot."""
         from ..haven import HavenState
         if self._haven is None:
             self._haven = HavenState(self, role="primary", lease_s=lease_s,
                                      window=window,
                                      stall_timeout_s=stall_timeout_s)
         self._haven.lease_s = float(lease_s)
+        if quorum_endpoints:
+            self._arm_quorum(quorum_endpoints, quorum_resource,
+                             quorum_lease_s, lease_s)
         self._haven.start_replication(backup_endpoint)
         return self
 
     def start_standby(self, lease_s: float = 2.0,
-                      auto_promote: bool = True) -> "ParameterServer":
+                      auto_promote: bool = True,
+                      quorum_endpoints=None,
+                      quorum_resource: Optional[str] = None,
+                      quorum_lease_s: Optional[float] = None
+                      ) -> "ParameterServer":
         """Arm this server as a standby BACKUP: it replays the primary's
         record stream, serves bounded-stale reads, redirects writes, and
         (with `auto_promote`) promotes itself when the primary's lease
         expires. A handover target passes `auto_promote=False` so a torn
-        handover can never elect two primaries."""
+        handover can never elect two primaries.
+
+        With `quorum_endpoints` configured, self-promotion additionally
+        requires a majority-granted quorum lease — `auto_promote=True`
+        is then safe even on partition-risky networks (the standby of a
+        merely-partitioned pair loses the election instead of
+        split-braining)."""
         from ..haven import HavenState
         if self._haven is None:
             self._haven = HavenState(self, role="backup", lease_s=lease_s)
         self._haven.lease_s = float(lease_s)
+        if quorum_endpoints:
+            self._arm_quorum(quorum_endpoints, quorum_resource,
+                             quorum_lease_s, lease_s)
         self._haven.start_standby(auto_promote=auto_promote)
         return self
 

@@ -78,7 +78,11 @@ class AsyncPSTrainer:
             comm_quant=getattr(transpiler.config, "comm_quant", None),
             replicas=replicas,
             dedup_pushes=replicas is not None,
-            trainer_id=transpiler._trainer_id)
+            trainer_id=transpiler._trainer_id,
+            quorum_endpoints=getattr(transpiler.config,
+                                     "quorum_endpoints", None),
+            quorum_resources=getattr(transpiler.config,
+                                     "quorum_resources", None))
         self.trainer_id = transpiler._trainer_id
         # tables sharing any ids feed must share one uniq/remap (a fed ids
         # var can only hold ONE remapping) — group them transitively

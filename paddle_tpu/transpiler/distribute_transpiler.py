@@ -98,6 +98,14 @@ class DistributeTranspilerConfig:
     # the server side via ParameterServer.start_replication() /
     # start_standby() (docs/FAULT_TOLERANCE.md §Replicated PS plane).
     haven_replicas = None
+    # fluid-quorum: the arbiter group backing the haven pairs' elections
+    # (a list of node endpoints) + {logical_endpoint: lease resource}.
+    # When set, the PS trainers' client asks the ARBITERS who a shard's
+    # primary is during failover — it can find a promoted primary at an
+    # endpoint no replica list names. Server-side arming stays on
+    # ParameterServer.start_replication/start_standby(quorum_endpoints=).
+    quorum_endpoints = None
+    quorum_resources = None
 
 
 class DistributeTranspiler:

@@ -594,6 +594,8 @@ def test_chaos_drill_cli(tmp_path):
     """The heavy drills ride tools/chaos_drill.py; keep tier-1 lean."""
     import subprocess
     import sys
+    # ps_partition is NOT in this list: its dedicated 3-seed wrapper
+    # below already covers seed 7 under both PS modes
     for scenario in ("flaky_rpc", "quant_flaky_rpc", "pserver_kill",
                      "ckpt_crash", "sync_evict", "ps_primary_kill",
                      "ps_handover"):
@@ -615,6 +617,29 @@ def test_chaos_drill_cli(tmp_path):
         if extra:
             assert (tmp_path / scenario / "traces"
                     / "merged_trace.json").exists()
+
+
+@pytest.mark.slow
+def test_ps_partition_drill_three_seeds(tmp_path):
+    """fluid-quorum CI gate: the asymmetric-partition drill — primary
+    cut from its backup and a majority of arbiters, backup keeps the
+    majority — must pass 3/3 seeds under BOTH PS modes (the drill
+    itself loops async and sync and asserts the single-write-acceptor
+    sampling, fenced step-down, bounded loss, and the healed-rejoin
+    resync; see tools/chaos_drill.py)."""
+    import subprocess
+    import sys
+    for seed in (5, 6, 7):
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "..", "tools",
+                          "chaos_drill.py"),
+             "--scenario", "ps_partition", "--seed", str(seed),
+             "--workdir", str(tmp_path / f"seed{seed}")],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, (seed, proc.stdout[-2000:],
+                                      proc.stderr[-2000:])
 
 
 @pytest.mark.slow

@@ -103,6 +103,28 @@ def test_update_log_watermark_window_and_degradation():
     assert not log.needs_resync and log.lag() == 0
 
 
+def test_update_log_lag_is_nonzero_while_resync_pending():
+    """Regression pin for a load-sensitive flake (the broken-barrier
+    test failed ~1-in-10 on a busy box): `resume()` advances the acked
+    watermark at the snapshot CUT, before the `haven_sync` snapshot
+    lands — `lag()` must NOT report 0 in that window, or every
+    "backup is current" probe (tests' ack-drain waits, the handover
+    drain, the lag gauges) races the in-flight install. The floor
+    lifts only at `rebase()` (snapshot confirmed); a DEGRADED log
+    still reports 0 (solo availability mode is idle, not backlog)."""
+    log = UpdateLog(window=8, stall_timeout_s=0.2)
+    assert log.needs_resync and log.lag() == 1   # fresh pair: not caught up
+    log.append("init_param", {})
+    log.append("push_grads_sync", {})
+    log.resume(log.head_seq)          # the quiesced cut: acked == head...
+    assert log.acked_seq == log.head_seq
+    assert log.lag() >= 1             # ...but the snapshot is in flight
+    log.rebase(log.head_seq)          # install acknowledged
+    assert log.lag() == 0
+    log.degrade()                     # degraded: deliberately solo
+    assert log.lag() == 0
+
+
 # -- replication ----------------------------------------------------------
 
 def test_replicated_pair_is_bit_identical_to_unreplicated_baseline():

@@ -210,3 +210,29 @@ def test_run_still_fast_pathed_after_flag_flip():
     finally:
         fluid.flags.set_flag("benchmark", False)
     assert len(exe._cache) == n_cache  # no recompile
+
+
+def test_donation_dropped_while_compile_cache_configured_on_cpu():
+    """Regression pin for the former ~1-in-6 flake of
+    test_wire.py::test_comm_quant_parallel_executor_zero_recompiles_and_band:
+    on this jaxlib, a warm persistent-cache hit of a donate_argnums
+    executable loses its input-output aliasing on the CPU backend
+    (donated-buffer use-after-free — bus errors, segfaults, or silent
+    state corruption under identical seeds). The runtime makes the
+    unsound combination unrepresentable: donation_safe() must be False
+    exactly when a compilation-cache dir is configured on a CPU
+    backend, and True the moment the cache is off (the TPU
+    training/serving posture, which never configures one)."""
+    from paddle_tpu.core.executor import donation_safe
+
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        # the tier-1 suite posture (conftest configures the cache):
+        jax.config.update("jax_compilation_cache_dir", "/tmp/_pin_cache")
+        assert jax.default_backend() == "cpu"
+        assert donation_safe() is False
+        # no cache dir -> full donation is sound again
+        jax.config.update("jax_compilation_cache_dir", None)
+        assert donation_safe() is True
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)

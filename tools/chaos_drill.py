@@ -55,6 +55,16 @@ Scenarios (all seed-deterministic through ark.chaos):
                   failed trainer steps, exactly ONE lease-holder at
                   every sampled instant, exact update continuity across
                   the flip, and the handover promotion metered
+    ps_partition  fluid-quorum: ASYMMETRIC partition of a quorum-armed
+                  haven pair under async AND sync PS — the primary is
+                  cut from its backup and from a majority of the three
+                  arbiters while the backup keeps the majority; PASS =
+                  at most one write-acceptor at every 5ms sample, the
+                  majority side promotes within the lease budget, the
+                  minority primary fences and steps down (epoch-stale
+                  writes rejected, not applied), zero trainer-visible
+                  failures, bounded loss, and the healed node rejoins
+                  as a resyncing standby with zero lost acked updates
 
 `--trace-out DIR` (any scenario): every participating process writes its
 chrome trace file into DIR (`trace_<process>.json`) and the drill merges
@@ -106,12 +116,15 @@ def _fresh_world(seed, n_servers=2, lr=0.1):
     return servers, tr, loss, batch
 
 
-def _build_world(eps, seed, lr=0.1, sync=False, haven_replicas=None):
+def _build_world(eps, seed, lr=0.1, sync=False, haven_replicas=None,
+                 quorum_endpoints=None, quorum_resources=None):
     """Trainer half of the 2-layer FC world, against endpoints that may
     live in ANOTHER process (the health_alerts drill's ps_worker).
     `sync=True` builds the pserver-runtime sync world (SyncPSTrainer);
     `haven_replicas` arms the client's primary re-resolution + tagged
-    pushes for the fluid-haven drills."""
+    pushes for the fluid-haven drills; `quorum_endpoints`/`_resources`
+    give the client the arbiters' view of who rules a shard
+    (fluid-quorum)."""
     from paddle_tpu.pserver import SyncPSTrainer
 
     np.random.seed(seed)
@@ -129,6 +142,9 @@ def _build_world(eps, seed, lr=0.1, sync=False, haven_replicas=None):
         cfg.runtime = "pserver"
     if haven_replicas:
         cfg.haven_replicas = dict(haven_replicas)
+    if quorum_endpoints:
+        cfg.quorum_endpoints = list(quorum_endpoints)
+        cfg.quorum_resources = dict(quorum_resources or {})
     t = fluid.DistributeTranspiler(cfg)
     t.transpile(trainer_id=0, program=main, pservers=eps, trainers=1,
                 sync_mode=sync)
@@ -813,6 +829,272 @@ def drill_ps_primary_kill(seed, workdir, trace_out=None):
             backup.stop()
 
 
+def drill_ps_partition(seed, workdir, trace_out=None):
+    """fluid-quorum: ASYMMETRIC network partition of a quorum-armed
+    haven pair, under async AND sync PS.
+
+    The partition isolates the primary from its backup AND from a
+    majority of the 3 arbiters (it keeps exactly one — the minority
+    side), while the backup reaches the majority and the trainer
+    reaches everyone — the scenario the crash-stop model could not
+    survive. PASS requires, per PS mode:
+
+      * at most ONE write-acceptor at every 5ms-grain sample across the
+        whole drill (the fenced minority primary holds, never acks);
+      * the majority side promotes within the lease budget and the
+        minority primary steps down (its later epoch-stale write is
+        REJECTED with a redirect, not applied);
+      * zero trainer-visible step failures and a final loss inside the
+        no-fault band;
+      * healing rejoins the deposed node as a resyncing standby,
+        bit-identical to the new primary, with zero lost acked updates
+        (the backup's pre-partition ack watermark survives);
+      * the promotion is metered (kind="quorum") and the grant /
+        step-down evidence is in the metrics + flight recorder.
+    """
+    import threading
+
+    from paddle_tpu.observe import flight as obs_flight
+    from paddle_tpu.pserver import ParameterServer
+    from paddle_tpu.quorum import QuorumNode
+
+    LEASE = 1.0
+    N_BASE = 14
+    for mode in ("async", "sync"):
+        sync = mode == "sync"
+        fluid.set_flag("observe", True)
+        obs_metrics.default_registry().reset()
+
+        # no-fault baseline: the loss band reference
+        solo = ParameterServer("127.0.0.1:0").start()
+        try:
+            tr, loss, batch = _build_world(solo.endpoint, seed, sync=sync)
+            ref = _run_steps(tr, loss, batch, N_BASE)
+            tr.close()
+        finally:
+            solo.stop()
+
+        qdir = os.path.join(workdir, f"quorum_{mode}")
+        nodes, servers = [], []
+        net, tr = None, None
+        stop = threading.Event()
+        try:
+            # everything that can fail to start lives INSIDE the try:
+            # a raised start (e.g. a lost bootstrap election) must not
+            # leak arbiter threads/servers into the rest of the CI run
+            nodes = [QuorumNode("127.0.0.1:0", qdir,
+                                node_id=f"n{i}").start()
+                     for i in range(3)]
+            qeps = [n.endpoint for n in nodes]
+            backup = ParameterServer("127.0.0.1:0").start()
+            servers.append(backup)
+            backup.start_standby(lease_s=LEASE, quorum_endpoints=qeps,
+                                 quorum_resource="shard0")
+            primary = ParameterServer("127.0.0.1:0").start()
+            servers.append(primary)
+            primary.start_replication(backup.endpoint, lease_s=LEASE,
+                                      quorum_endpoints=qeps,
+                                      quorum_resource="shard0")
+            servers = [primary, backup]
+            tr, loss, batch = _build_world(
+                primary.endpoint, seed, sync=sync,
+                haven_replicas={primary.endpoint: [backup.endpoint]},
+                quorum_endpoints=qeps,
+                quorum_resources={primary.endpoint: "shard0"})
+            losses, failures = [], []
+
+            def train_loop():
+                while not stop.is_set():
+                    try:
+                        l, = tr.step(batch(), fetch_list=[loss])
+                        losses.append(float(np.asarray(l).reshape(-1)[0]))
+                    except Exception as e:          # noqa: BLE001
+                        failures.append(repr(e))
+
+            # 5ms write-acceptance sampler over BOTH members: fenced or
+            # held primaries report accepting=False, so the invariant
+            # is at most one True at every sample
+            violations = []
+
+            def sample_acceptors():
+                while not stop.is_set():
+                    acc = [s._haven.status()["accepting"] for s in servers]
+                    if sum(acc) > 1:
+                        violations.append(list(acc))
+                    time.sleep(0.005)
+
+            # flight-ring collector: the bounded ring holds <1s of
+            # history at this step rate, so the promotion/step-down
+            # evidence is harvested continuously instead of at the end
+            seen_events = {"haven_promotion": [], "haven_step_down": []}
+
+            def collect_flight():
+                while not stop.is_set():
+                    for k, acc_l in seen_events.items():
+                        for e in obs_flight.get_flight().events(k):
+                            if e not in acc_l:
+                                acc_l.append(e)
+                    time.sleep(0.05)
+
+            t_train = threading.Thread(target=train_loop, daemon=True)
+            t_samp = threading.Thread(target=sample_acceptors, daemon=True)
+            t_coll = threading.Thread(target=collect_flight, daemon=True)
+            t_train.start()
+            t_samp.start()
+            t_coll.start()
+            time.sleep(1.2)
+            pre_steps = len(losses)
+            _check(pre_steps > 0, f"[{mode}] healthy steps before the "
+                                  f"partition ({pre_steps})")
+            pre_acked = primary._haven.log.acked_seq
+
+            # the asymmetric cut: pair severed; primary keeps ONE
+            # arbiter (minority), backup keeps all three (majority);
+            # the trainer reaches everyone
+            net = chaos.NetPartition(seed=seed).start()
+            net.isolate(primary.endpoint, backup.endpoint)
+            net.block(primary.endpoint, qeps[1])
+            net.block(primary.endpoint, qeps[2])
+            print(f"  [{mode}] partition up: primary sees 1/3 arbiters, "
+                  f"backup sees 3/3, pair severed")
+
+            budget_s = LEASE + LEASE / 3.0 + 2.0   # expiry + poll + grants
+            t0 = time.monotonic()
+            while backup._haven.role != "primary":
+                if time.monotonic() - t0 > budget_s + 5.0:
+                    raise DrillFailure(
+                        f"[{mode}] backup never promoted "
+                        f"(backup={backup._haven.status()})")
+                time.sleep(0.01)
+            took = time.monotonic() - t0
+            _check(took <= budget_s + 2.0,
+                   f"[{mode}] majority-side promotion in {took:.2f}s "
+                   f"(lease budget ~{budget_s:.1f}s)")
+            t0 = time.monotonic()
+            while primary._haven.role == "primary":
+                if time.monotonic() - t0 > budget_s + 5.0:
+                    raise DrillFailure(f"[{mode}] minority primary never "
+                                       f"stepped down")
+                time.sleep(0.01)
+            _check(primary._haven.role == "backup"
+                   and not primary._haven.has_synced,
+                   f"[{mode}] minority primary stepped down to an "
+                   f"UNSYNCED standby")
+
+            # epoch-stale write at the deposed node: REJECTED (redirect
+            # verdict — the node no longer rules), never applied. The
+            # raw client has no replica/quorum route on purpose: it
+            # models a stale trainer still holding the old primary's
+            # socket.
+            w_before = {n: v.copy() for n, v in primary._dense.items()}
+            raw = PSClient([primary.endpoint], failover_s=1.0)
+            name = sorted(w_before)[0]
+            rejected = False
+            try:
+                raw._call(primary.endpoint, "push_grad", name=name,
+                          grad=np.ones_like(w_before[name]))
+            except RuntimeError as e:
+                rejected = "NotPrimary" in str(e) or "redirect" in str(e)
+                print(f"  [{mode}] stale write rejected: {str(e)[:80]}")
+            raw.close()
+            _check(rejected, f"[{mode}] deposed node answered the stale "
+                             f"write with a rejection")
+            _check(all(np.array_equal(primary._dense[n], w_before[n])
+                       for n in w_before),
+                   f"[{mode}] deposed node applied NOTHING after the "
+                   f"step-down (epoch-stale writes rejected)")
+
+            # zero lost acked updates: the promoted backup's replay
+            # watermark covers everything it had acknowledged
+            _check(backup._haven.applied_seq >= pre_acked,
+                   f"[{mode}] acked prefix survives "
+                   f"({backup._haven.applied_seq} >= {pre_acked})")
+
+
+            time.sleep(1.0)   # traffic against the new primary
+            # heal: the deposed node rejoins as a resyncing standby
+            net.heal()
+            print(f"  [{mode}] partition healed")
+            t0 = time.monotonic()
+            while not primary._haven.has_synced:
+                if time.monotonic() - t0 > 20.0:
+                    raise DrillFailure(f"[{mode}] healed node never "
+                                       f"resynced")
+                time.sleep(0.02)
+            time.sleep(0.6)
+            stop.set()
+            t_train.join(timeout=30)
+            t_samp.join(timeout=5)
+
+            _check(not failures,
+                   f"[{mode}] zero trainer-visible failures "
+                   f"({len(losses)} steps; first: "
+                   f"{failures[0] if failures else None})")
+            _check(len(losses) > pre_steps,
+                   f"[{mode}] training continued through the partition "
+                   f"({len(losses) - pre_steps} post-cut steps)")
+            _check(not violations,
+                   f"[{mode}] at most one write-acceptor at every 5ms "
+                   f"sample ({violations[:3] if violations else 'clean'})")
+            _check(np.isfinite(losses).all(), f"[{mode}] all losses finite")
+            band = np.mean(ref[-6:]) * 1.25 + 0.05
+            _check(np.mean(losses[-6:]) < band,
+                   f"[{mode}] final loss {np.mean(losses[-6:]):.4f} "
+                   f"inside the no-fault band (<{band:.4f})")
+
+            # healed standby is bit-identical to the new primary at the
+            # drained watermark
+            deadline = time.monotonic() + 10.0
+            while backup._haven.log.lag() > 0:
+                if time.monotonic() > deadline:
+                    raise DrillFailure(f"[{mode}] resync never drained")
+                time.sleep(0.02)
+            _check(all(np.array_equal(primary._dense[n],
+                                      backup._dense[n])
+                       for n in backup._dense),
+                   f"[{mode}] healed standby bit-identical to the new "
+                   f"primary")
+
+            reg = obs_metrics.default_registry()
+            promoted = reg.get("ps_promotions_total")
+            _check(promoted is not None
+                   and promoted.value(kind="quorum") >= 1,
+                   f"[{mode}] quorum promotion metered")
+            stepdowns = reg.get("ps_step_downs_total")
+            _check(stepdowns is not None and stepdowns.total() >= 1,
+                   f"[{mode}] step-down metered")
+            grants = reg.get("quorum_grants_total")
+            _check(grants is not None
+                   and grants.value(outcome="granted") >= 2,
+                   f"[{mode}] grants metered "
+                   f"(bootstrap + election)")
+            epoch_g = reg.get("quorum_lease_epoch")
+            _check(epoch_g is not None
+                   and epoch_g.value(resource="shard0") >= 2,
+                   f"[{mode}] quorum_lease_epoch gauge advanced")
+            _check(any(e.get("endpoint") == backup.endpoint
+                       and e.get("promotion") == "quorum"
+                       for e in seen_events["haven_promotion"]),
+                   f"[{mode}] promotion in the flight recorder")
+            _check(any(e.get("endpoint") == primary.endpoint
+                       for e in seen_events["haven_step_down"]),
+                   f"[{mode}] step-down in the flight recorder")
+        finally:
+            stop.set()
+            if net is not None:
+                net.stop()
+            if tr is not None:
+                try:
+                    tr.close()
+                except Exception:   # noqa: BLE001
+                    pass
+            fluid.set_flag("observe", False)
+            for s in servers:
+                s.stop()
+            for n in nodes:
+                n.stop()
+
+
 def drill_ps_handover(seed, workdir, trace_out=None):
     """fluid-haven: planned live shard handoff under continuous async
     training load (see module docstring)."""
@@ -901,6 +1183,7 @@ SCENARIOS = {
     "flaky_rpc": drill_flaky_rpc,
     "ps_primary_kill": drill_ps_primary_kill,
     "ps_handover": drill_ps_handover,
+    "ps_partition": drill_ps_partition,
     "replica_kill": drill_replica_kill,
     "quant_flaky_rpc": drill_quant_flaky_rpc,
     "pserver_kill": drill_pserver_kill,
