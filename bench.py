@@ -551,6 +551,26 @@ def quorum_subprocess():
     return rec
 
 
+def elastic_subprocess():
+    """fluid-elastic numbers (tools/elastic_bench.py — the HA data
+    plane is host TCP + json): `master_failover_blip_ms` — the largest
+    consumer-visible stall streaming task leases across a SIGKILL'd
+    primary master (lease expiry + quorum election + client
+    re-resolution, gated against the 2-lease + retry/resolve
+    `master_failover_budget_ms`) — and `elastic_scaleup_admission_s`,
+    the first-heartbeat-to-counted-world latency of a NEW trainer id
+    joining a running sync-PS world (barrier-epoch admission)."""
+    rec, rc = _tool_json("elastic_bench.py", "elastic bench", timeout=420)
+    if rec is None:
+        return {"master_failover_blip_ms": 0.0,
+                "master_failover_ok": False,
+                "elastic_scaleup_admission_s": -1.0,
+                "elastic_scaleup_ok": False}
+    if rc:
+        rec["elastic_bench_rc"] = rc
+    return rec
+
+
 def planner_subprocess(peak_tflops, measured_mfu):
     """fluid-planner agreement segment (tools/paddle_plan.py, CPU
     subprocess — the plan is a static walk, no device work): predicted
@@ -1024,6 +1044,12 @@ def main():
     _obs.flight.set_stage("quorum_subprocess")
     quorumrec = quorum_subprocess()
     note(**quorumrec)
+    # fluid-elastic: master-failover blip vs its lease+retry budget +
+    # the scale-up admission latency of a new trainer joining mid-job
+    _PARTIAL["extra"]["failure_stage"] = "elastic_subprocess"
+    _obs.flight.set_stage("elastic_subprocess")
+    elasticrec = elastic_subprocess()
+    note(**elasticrec)
     # the headline pair is drift-sensitive through the dev tunnel, and
     # the noise is ONE-SIDED: a stall can only lower a reading below the
     # true device rate, never raise it (the device cannot run faster

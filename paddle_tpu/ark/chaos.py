@@ -319,6 +319,20 @@ def kill_server(server) -> str:
     return ep
 
 
+def kill_master(master) -> str:
+    """SIGKILL-equivalent death of an in-process data `Master`
+    (fluid-elastic): listener and every live connection die now,
+    in-flight requests dropped unanswered, and its quorum lease is NOT
+    resigned — it expires at the arbiters like a real dead process's
+    would. Returns the endpoint."""
+    from ..observe import flight as _flight
+
+    ep = master.endpoint
+    _flight.note("chaos_kill_master", endpoint=ep)
+    master.stop()
+    return ep
+
+
 def restart_server(endpoint: str, trainers: int = 1,
                    sync_timeout: float = 120.0,
                    recover_dir: Optional[str] = None):

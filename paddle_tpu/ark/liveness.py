@@ -195,6 +195,12 @@ class EvictingBarrier:
         self._full = int(parties)
         self._action = action
         self._evicted: set = set()
+        # fluid-elastic scale-UP: members admitted via join() while a
+        # generation is in flight wait here until the generation
+        # boundary — the world never grows mid-batch; _joined remembers
+        # landed admissions so a replayed join can never double-grow
+        self._joining: set = set()
+        self._joined: set = set()
         self._arrived = 0
         # members that identified themselves on arrival this generation:
         # evicting one of them must DISCOUNT its arrival, or the barrier
@@ -223,6 +229,30 @@ class EvictingBarrier:
         with self._cond:
             return self._broken
 
+    def join(self, member) -> bool:
+        """Grow the sync world by a NEW member (fluid-elastic scale-UP):
+        admission lands at the NEXT generation boundary, never
+        mid-batch — an in-flight generation's threshold is unchanged,
+        and the joiner's arrival starts counting only once every member
+        of the grown world can arrive too. An idle barrier (no arrivals
+        this generation) admits immediately. Joining a member that was
+        EVICTED is a readmit (the party count it once held grows back).
+        Returns True when membership changed."""
+        with self._cond:
+            if member in self._evicted:
+                self._evicted.discard(member)
+                self._cond.notify_all()
+                return True
+            if member in self._joining or member in self._joined:
+                return False               # replayed join: no double-grow
+            if self._arrived == 0:
+                self._full += 1
+                self._joined.add(member)
+                self._cond.notify_all()
+            else:
+                self._joining.add(member)
+            return True
+
     def evict(self, member) -> bool:
         """Shrink the live party count by `member`; returns True when the
         eviction is new. If the member already ARRIVED this generation
@@ -230,6 +260,20 @@ class EvictingBarrier:
         threshold must be met by live arrivals only. Waiters re-check
         completion immediately."""
         with self._cond:
+            if member in self._joining:
+                # admitted-then-died before any generation boundary:
+                # land the admission and evict it in ONE move (+1 full,
+                # +1 evicted — the live count never moved), so a later
+                # heartbeat READMITS it like any evicted member instead
+                # of leaving it stranded outside every membership set
+                # (where its arrivals would count as ghosts against a
+                # threshold that never included it)
+                self._joining.discard(member)
+                self._full += 1
+                self._joined.add(member)
+                self._evicted.add(member)
+                self._cond.notify_all()
+                return True
             if member in self._evicted:
                 return False
             if len(self._evicted) + 1 >= self._full:
@@ -267,6 +311,11 @@ class EvictingBarrier:
         self._gen += 1
         self._arrived = 0
         self._arrived_members.clear()
+        if self._joining:
+            # the generation boundary: deferred admissions land now
+            self._full += len(self._joining)
+            self._joined |= self._joining
+            self._joining.clear()
         if status == "broken":
             self._broken = True
         self._cond.notify_all()
@@ -282,10 +331,12 @@ class EvictingBarrier:
             if self._broken:
                 raise BrokenBarrierError
             gen = self._gen
-            if member is not None and member in self._evicted:
+            if member is not None and (member in self._evicted
+                                       or member in self._joining):
                 # a zombie arrival (evicted member not yet readmitted)
-                # must not count toward the live threshold; it just waits
-                # out the generation
+                # or a joiner awaiting its admission boundary must not
+                # count toward the live threshold; it just waits out
+                # the generation
                 pass
             else:
                 self._arrived += 1

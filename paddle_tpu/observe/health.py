@@ -42,6 +42,13 @@ paper's per-task health story. Three pieces:
                              will step down at local expiry unless the
                              quorum comes back (self-clears on re-grant
                              or successful renew)
+  * ``task_starvation``      fluid-elastic: the data master holds
+                             outstanding tasks but no issue/finish
+                             progress landed for a window — the data
+                             plane is starved (self-clears)
+  * ``task_discard``         fluid-elastic: a task burned its failure
+                             budget and was discarded — its records
+                             are silently lost for the pass (sticky)
   * ``wire_compression_collapse`` on-wire ratio fell to half of the
                              session's established ratio
 
@@ -528,6 +535,79 @@ class QuorumLossDetector(Detector):
         engine.clear(self)
 
 
+class TaskStarvationDetector(Detector):
+    """fluid-elastic: the data master holds outstanding work (todo +
+    pending gauges > 0) but NO task has been issued or finished for a
+    window — trainers stopped pulling (all dead? all wedged on a fenced
+    master?) or the master stopped issuing. Requires the progress
+    series to have EVER moved, so a freshly loaded dataset whose
+    trainers simply haven't started yet never fires. Self-clears on the
+    next issue/finish."""
+
+    name = "task_starvation"
+    series = "master_task_progress"
+
+    def __init__(self, window_s: float = 15.0):
+        self.window_s = window_s
+
+    def check(self, engine, now):
+        reg = _metrics.default_registry()
+        outstanding = 0.0
+        for metric in ("master_tasks_todo", "master_tasks_pending"):
+            g = reg.get(metric)
+            if g is not None:
+                outstanding += sum(v for _l, v in g.items())
+        ts = engine.series(self.series)
+        if outstanding <= 0 or len(ts) == 0:
+            engine.clear(self)
+            return
+        s, _n = ts.window_sum(self.window_s, now=now)
+        if s == 0:
+            engine.fire(self, observed=outstanding, threshold=0,
+                        message=f"{outstanding:.0f} tasks outstanding but "
+                                f"no issue/finish progress in "
+                                f"{self.window_s:.0f}s — the data plane "
+                                f"is starved")
+        else:
+            engine.clear(self)
+
+
+class TaskDiscardDetector(Detector):
+    """fluid-elastic: a task burned its failure budget and was DISCARDED
+    — every record it carried is silently lost for this pass (today's
+    quiet data-loss mode, reference processFailedTask :323). STICKY:
+    lost data does not come back; after remediation (re-run the pass)
+    an operator clears it with `engine.clear_alerts()`. Discards that
+    pre-date the health plane arming are baselined, not alerted."""
+
+    name = "task_discard"
+    series = "master_task_discards"
+
+    def __init__(self):
+        self._baseline: Optional[float] = None
+
+    def check(self, engine, now):
+        if engine.active_alert(self.name) is not None:
+            return  # sticky
+        c = _metrics.default_registry().get("master_tasks_discarded_total")
+        total = c.total() if c is not None else 0.0
+        if self._baseline is None or total < self._baseline:
+            # first check of a plane armed mid-run, or a registry reset
+            self._baseline = total
+            return
+        if total > self._baseline:
+            engine.fire(self, observed=total,
+                        threshold=self._baseline,
+                        message=f"{total - self._baseline:.0f} task(s) "
+                                f"discarded after burning their failure "
+                                f"budget — their records are LOST for "
+                                f"this pass")
+
+    def acknowledge(self, engine):
+        c = _metrics.default_registry().get("master_tasks_discarded_total")
+        self._baseline = c.total() if c is not None else 0.0
+
+
 class CompressionCollapseDetector(Detector):
     """fluid-wire ratio collapse: the windowed raw/on-wire byte ratio
     fell to half of the best ratio this session established. A session
@@ -595,6 +675,12 @@ DEFAULT_WATCHES = (
     # fluid-quorum: renew verdicts (1 ok / 0 failing while held) — the
     # quorum_loss detector's evidence series for alert postmortems
     ("quorum_lease_ok", "quorum_lease_ok", None),
+    # fluid-elastic: master task-lifecycle progress (issues + finishes
+    # both count — either proves the data plane is moving) and the
+    # discard stream the task_discard detector baselines against
+    ("master_tasks_issued_total", "master_task_progress", None),
+    ("master_tasks_finished_total", "master_task_progress", None),
+    ("master_tasks_discarded_total", "master_task_discards", None),
 )
 
 
@@ -718,6 +804,8 @@ class HealthEngine:
                                       window_s=15.0, threshold=8.0),
                     ReplicationStallDetector(),
                     QuorumLossDetector(),
+                    TaskStarvationDetector(),
+                    TaskDiscardDetector(),
                     CompressionCollapseDetector()):
             self.add_detector(det)
         self._ensure_watches()   # arms only the not-yet-armed specs

@@ -115,6 +115,12 @@ class ParameterServer:
         self._lease = LeaseTable()
         self._sync_barrier = EvictingBarrier(trainers,
                                              action=self._apply_pending)
+        # fluid-elastic scale-UP: trainer ids the sync world knows. A
+        # heartbeat from a NEVER-SEEN id is a replacement/extra trainer
+        # joining a running job — the barrier grows at the next
+        # generation boundary (EvictingBarrier.join), never mid-batch.
+        self._known_members: set = set(range(trainers))
+        self._members_lock = threading.Lock()
         self._locks: Dict[str, threading.Lock] = {}
         self._global_lock = threading.Lock()
         self._barrier = threading.Barrier(trainers) if trainers > 1 else None
@@ -613,19 +619,42 @@ class ParameterServer:
         readmitted — the barrier's party count grows back and its fresh
         session nonce resets its sync watermark on first push."""
         self._lease.beat(trainer_id, session=session, lease_s=lease_s)
-        if self._sync_barrier.readmit(trainer_id):
+        key = trainer_id if isinstance(trainer_id, str) else int(trainer_id)
+        if self._sync_barrier.readmit(key):
             logger.info("pserver %s: trainer %s readmitted after "
                         "heartbeat (lease %.1fs)", self.endpoint,
                         trainer_id, lease_s)
             # lease transitions go to the black box unconditionally —
             # they are rare and exactly what a postmortem wants
-            _flight.note("lease_readmit", trainer_id=int(trainer_id),
+            _flight.note("lease_readmit", trainer_id=key,
                          endpoint=self.endpoint)
             if _flags.get_flag("observe"):
                 _metrics.counter(
                     "pserver_trainers_readmitted_total",
                     "evicted trainers readmitted after a fresh "
                     "heartbeat").inc()
+        else:
+            with self._members_lock:
+                is_new = key not in self._known_members
+                if is_new:
+                    self._known_members.add(key)
+            if is_new and self._sync_barrier.join(key):
+                # fluid-elastic: a NEW leaseholder grows the sync world
+                # at the next barrier epoch (never mid-batch); its first
+                # pull reads the current params, its fresh session nonce
+                # starts a fresh sync watermark
+                logger.info(
+                    "pserver %s: NEW trainer %s admitted to the sync "
+                    "world (grows to %d at the next barrier epoch, "
+                    "lease %.1fs)", self.endpoint, key,
+                    self._sync_barrier.live_parties, lease_s)
+                _flight.note("lease_admit", trainer_id=key,
+                             endpoint=self.endpoint)
+                if _flags.get_flag("observe"):
+                    _metrics.counter(
+                        "pserver_trainers_admitted_total",
+                        "new trainers admitted to a running sync world "
+                        "on first heartbeat").inc()
         return ("ok", {"live_trainers": self._sync_barrier.live_parties,
                        "leases": self._lease.snapshot()})
 

@@ -1,0 +1,664 @@
+"""fluid-elastic: HA data plane — quorum-backed master failover,
+exactly-once task accounting, ark-idiom snapshots, and end-to-end
+trainer churn (scale-down AND scale-up).
+
+Reference analogs: go/master/service.go's etcd-leased HA master and the
+TF system paper's dynamic-worker fault tolerance. The heavy drills ride
+`tools/chaos_drill.py --scenario master_kill|master_partition|
+trainer_churn` (slow CI wrappers at the bottom); tier-1 pins the
+mechanisms lean and fast."""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import ark
+from paddle_tpu.ark.liveness import EvictingBarrier
+from paddle_tpu.master import DatasetMismatchError, Master, MasterClient
+from paddle_tpu.pserver import ParameterServer, PSClient
+from paddle_tpu.quorum import QuorumNode
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# snapshot corpus: the ark atomic idiom + previous-serial fallback
+# ---------------------------------------------------------------------------
+
+def _seed_master_snapshot(snap):
+    """Two mutations so BOTH serials (current + .prev) exist."""
+    m = Master("127.0.0.1:0", snapshot_path=snap, timeout_dur=60).start()
+    c = MasterClient(m.endpoint)
+    c.set_dataset(["a", "b", "c", "d"], chunks_per_task=2)
+    _, t1 = c.get_task()
+    c.task_finished(t1["task_id"], t1["epoch"])
+    _, t2 = c.get_task()
+    c.task_finished(t2["task_id"], t2["epoch"])
+    c.close()
+    m.stop()
+
+
+@pytest.mark.parametrize("corruption", ["truncated", "bitflip", "empty"])
+def test_master_snapshot_torn_falls_back_to_previous_serial(
+        tmp_path, corruption):
+    """A torn/bit-rotted CURRENT snapshot recovers from the `.prev`
+    serial (one mutation behind, the documented fallback) — never a
+    JSONDecodeError out of recovery."""
+    snap = str(tmp_path / "master.json")
+    _seed_master_snapshot(snap)
+    if corruption == "truncated":
+        raw = open(snap).read()
+        open(snap, "w").write(raw[: len(raw) // 2])
+    elif corruption == "bitflip":
+        doc = json.load(open(snap))
+        doc["state"]["done"][0]["task_id"] = 999   # sha now mismatches
+        json.dump(doc, open(snap, "w"))
+    else:
+        open(snap, "w").write("")
+    m = Master("127.0.0.1:0", snapshot_path=snap).start()
+    try:
+        c = MasterClient(m.endpoint)
+        st = c.stats()
+        # the previous serial: 2 tasks total, one finish may be lost
+        assert st["done"] + st["todo"] == 2 and st["pending"] == 0, st
+        assert st["done"] >= 1
+        c.close()
+    finally:
+        m.stop()
+
+
+def test_master_snapshot_double_corruption_starts_empty(tmp_path):
+    """Both serials gone: recovery starts EMPTY with a log line — it
+    never crashes the process."""
+    snap = str(tmp_path / "master.json")
+    _seed_master_snapshot(snap)
+    open(snap, "w").write("garbage{")
+    open(snap + ".prev", "wb").write(b"\x00\xff\x01")
+    m = Master("127.0.0.1:0", snapshot_path=snap).start()
+    try:
+        c = MasterClient(m.endpoint)
+        assert c.stats() == {"todo": 0, "pending": 0, "done": 0}
+        # and the dataset can be re-registered
+        c.set_dataset(["x", "y"])
+        s, _ = c.get_task()
+        assert s == "ok"
+        c.close()
+    finally:
+        m.stop()
+
+
+def test_master_legacy_flat_snapshot_still_loads(tmp_path):
+    """Pre-elastic snapshots (flat dict, no embedded sha) keep loading:
+    pending returns to todo, pass survives."""
+    snap = str(tmp_path / "legacy.json")
+    legacy = {"todo": [{"task_id": 0, "payload": ["a"], "epoch": 0,
+                        "num_failure": 0}],
+              "pending": [{"task_id": 1, "payload": ["b"], "epoch": 2,
+                           "num_failure": 1}],
+              "done": [{"task_id": 2, "payload": ["c"], "epoch": 1,
+                        "num_failure": 0}],
+              "pass": 3}
+    json.dump(legacy, open(snap, "w"))
+    m = Master("127.0.0.1:0", snapshot_path=snap).start()
+    try:
+        c = MasterClient(m.endpoint)
+        st = c.stats()
+        assert st == {"todo": 2, "pending": 0, "done": 1}, st
+        assert m.ha_status()["pass"] == 3
+        # legacy state carries no fingerprint: re-registration stays the
+        # historical silent no-op
+        c.set_dataset(["whatever"])
+        assert c.stats()["todo"] == 2
+        c.close()
+    finally:
+        m.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite: set_dataset mismatch detection
+# ---------------------------------------------------------------------------
+
+def test_master_set_dataset_mismatch_raises(tmp_path):
+    snap = str(tmp_path / "m.json")
+    m = Master("127.0.0.1:0", snapshot_path=snap, timeout_dur=60).start()
+    try:
+        c = MasterClient(m.endpoint)
+        c.set_dataset(["a", "b", "c", "d"], chunks_per_task=2)
+        # identical re-registration: the historical idempotent no-op
+        c.set_dataset(["a", "b", "c", "d"], chunks_per_task=2)
+        assert c.stats()["todo"] == 2
+        # a DIFFERENT dataset: pointed error, not silent wrong training
+        with pytest.raises(RuntimeError, match="mismatch"):
+            c.set_dataset(["x", "y"])
+        # a different chunking of the same payloads is a different task
+        # set too
+        with pytest.raises(RuntimeError, match="mismatch"):
+            c.set_dataset(["a", "b", "c", "d"], chunks_per_task=1)
+        c.close()
+    finally:
+        m.stop()
+
+    # the mismatch survives recovery (the fingerprint is in the snapshot)
+    m2 = Master("127.0.0.1:0", snapshot_path=snap).start()
+    try:
+        with pytest.raises(DatasetMismatchError):
+            m2.set_dataset(["x", "y", "z"])
+        m2.set_dataset(["a", "b", "c", "d"], chunks_per_task=2)  # no-op
+    finally:
+        m2.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite: clean generator close returns the lease immediately
+# ---------------------------------------------------------------------------
+
+def test_records_generator_close_returns_lease_without_failure_burn():
+    """A trainer shutting down mid-task (GeneratorExit) must hand the
+    lease back NOW — re-issue is immediate, not timeout-bound — and
+    without burning num_failure (failure_max=0 would otherwise discard
+    the task on its very next settle)."""
+    m = Master("127.0.0.1:0", timeout_dur=60.0, failure_max=0).start()
+    try:
+        c = MasterClient(m.endpoint)
+        c.set_dataset(["only-item"])
+        gen = c.records(lambda item: [item])
+        assert next(gen) == "only-item"
+        gen.close()                      # trainer shutdown mid-task
+        # the lease came back instantly: with timeout_dur=60 a stranded
+        # lease would answer "none" for a minute
+        s, t = c.get_task()
+        assert s == "ok", s
+        # ...and the budget was NOT burned: epoch advanced, failures 0
+        assert t["epoch"] == 2
+        with m._lock:
+            assert m._pending[t["task_id"]].num_failure == 0
+        assert c.task_finished(t["task_id"], t["epoch"])
+        s, _ = c.get_task()
+        assert s == "no_more"
+        c.close()
+    finally:
+        m.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite: MasterClient retry across a master restart
+# ---------------------------------------------------------------------------
+
+def test_master_client_retries_across_master_restart(tmp_path):
+    snap = str(tmp_path / "m.json")
+    port = _free_port()
+    ep = f"127.0.0.1:{port}"
+    m = Master(ep, snapshot_path=snap, timeout_dur=60).start()
+    c = MasterClient(ep, retry=ark.RetryPolicy(max_attempts=8,
+                                               base_delay=0.05, seed=3))
+    try:
+        c.set_dataset(list(range(4)), chunks_per_task=2)
+        _, t = c.get_task()
+        c.task_finished(t["task_id"], t["epoch"])
+        m.stop()
+        time.sleep(0.1)
+
+        # restart on the SAME endpoint while the client retries
+        def restart():
+            time.sleep(0.3)
+            Master(ep, snapshot_path=snap, timeout_dur=60).start()
+
+        threading.Thread(target=restart, daemon=True).start()
+        s, t2 = c.get_task()            # rides the backoff transparently
+        assert s == "ok"
+        assert c.task_finished(t2["task_id"], t2["epoch"])
+        assert c.stats()["done"] == 1 + 1  # recovered serial kept t1 done
+    finally:
+        c.close()
+        # reach the restarted instance for shutdown
+        MasterClient(ep).stop_master()
+
+
+# ---------------------------------------------------------------------------
+# concurrent multi-client task lifecycle (satellite: today's tier-1 is
+# single-client only)
+# ---------------------------------------------------------------------------
+
+def test_concurrent_multi_client_task_lifecycle():
+    """N threads pulling from one master: no task issued twice at one
+    epoch, no task lost, and a stale task_finished after a re-issue is
+    rejected."""
+    N_TASKS, N_CLIENTS = 40, 6
+    m = Master("127.0.0.1:0", timeout_dur=30.0).start()
+    try:
+        admin = MasterClient(m.endpoint)
+        admin.set_dataset(list(range(N_TASKS)), chunks_per_task=1)
+        lock = threading.Lock()
+        issued, finished, errors = [], [], []
+
+        def worker(cid):
+            c = MasterClient(m.endpoint)
+            try:
+                while True:
+                    s, t = c.get_task()
+                    if s == "no_more":
+                        return
+                    if s == "none":
+                        time.sleep(0.005)
+                        continue
+                    with lock:
+                        issued.append((t["task_id"], t["epoch"]))
+                    if c.task_finished(t["task_id"], t["epoch"]):
+                        with lock:
+                            finished.append(t["task_id"])
+            except Exception as e:       # noqa: BLE001
+                with lock:
+                    errors.append(repr(e))
+            finally:
+                c.close()
+
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(N_CLIENTS)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=60)
+        assert not errors, errors
+        # no task issued twice at one epoch
+        assert len(issued) == len(set(issued)), "duplicate (task, epoch)"
+        # no task lost: every task finished exactly once
+        assert sorted(finished) == list(range(N_TASKS))
+        st = admin.stats()
+        assert st == {"todo": 0, "pending": 0, "done": N_TASKS}
+        admin.close()
+    finally:
+        m.stop()
+
+
+def test_stale_finish_after_reissue_rejected():
+    m = Master("127.0.0.1:0", timeout_dur=0.3, failure_max=5,
+               check_interval=0.05).start()
+    try:
+        c = MasterClient(m.endpoint)
+        c.set_dataset(["only"])
+        _, t = c.get_task()
+        time.sleep(0.7)                       # lease expires, re-queued
+        s, t2 = c.get_task()
+        assert s == "ok" and t2["epoch"] > t["epoch"]
+        # the stale first lease can no longer finish the task
+        assert c.task_finished(t["task_id"], t["epoch"]) is False
+        assert c.task_finished(t2["task_id"], t2["epoch"]) is True
+        c.close()
+    finally:
+        m.stop()
+
+
+# ---------------------------------------------------------------------------
+# master HA: replication, quorum-fenced promotion, exactly-once
+# ---------------------------------------------------------------------------
+
+def _ha_pair(tmp_path, lease_s=0.4):
+    nodes = [QuorumNode("127.0.0.1:0", str(tmp_path / "q"),
+                        node_id=f"t{i}").start() for i in range(3)]
+    qeps = [n.endpoint for n in nodes]
+    standby = Master("127.0.0.1:0").start()
+    standby.start_standby(lease_s=lease_s, quorum_endpoints=qeps,
+                          quorum_resource="t-master")
+    primary = Master("127.0.0.1:0", timeout_dur=30.0,
+                     check_interval=0.1).start()
+    primary.start_replication(standby.endpoint, lease_s=lease_s,
+                              quorum_endpoints=qeps,
+                              quorum_resource="t-master")
+    return nodes, qeps, primary, standby
+
+
+def test_master_failover_preserves_pending_lease_exactly_once(tmp_path):
+    """The exactly-once pin: a lease issued at the old primary is still
+    settleable at the promoted standby — the task-id/epoch pair
+    matches, the finish is accepted ONCE, and its replay reads stale."""
+    nodes, qeps, primary, standby = _ha_pair(tmp_path)
+    try:
+        cli = MasterClient(primary.endpoint,
+                           standbys=[standby.endpoint],
+                           quorum_endpoints=qeps,
+                           quorum_resource="t-master", failover_s=15.0)
+        cli.set_dataset(list(range(6)), chunks_per_task=2)
+        s, t = cli.get_task()
+        assert s == "ok"
+        primary.stop()                       # SIGKILL-equivalent
+        deadline = time.monotonic() + 10
+        while standby.ha_status()["role"] != "primary":
+            assert time.monotonic() < deadline, standby.ha_status()
+            time.sleep(0.02)
+        assert standby.fence_epoch > 1
+        # the surviving trainer's settle lands exactly once
+        assert cli.task_finished(t["task_id"], t["epoch"]) is True
+        assert cli.task_finished(t["task_id"], t["epoch"]) is False
+        # the pass drains at the promoted master
+        done = 1
+        while True:
+            s, t = cli.get_task()
+            if s == "no_more":
+                break
+            if s == "none":
+                time.sleep(0.02)
+                continue
+            assert cli.task_finished(t["task_id"], t["epoch"])
+            done += 1
+        assert done == 3
+        cli.close()
+    finally:
+        primary.stop()
+        standby.stop()
+        for n in nodes:
+            n.stop()
+
+
+def test_standby_redirects_task_commands(tmp_path):
+    """A standby (and, by the same gate, a fenced/deposed primary) must
+    never mutate task state: task commands answer with a redirect the
+    client surfaces as NotMaster when nothing rules."""
+    standby = Master("127.0.0.1:0").start()
+    standby.start_standby(lease_s=30.0, auto_promote=False)
+    try:
+        c = MasterClient(standby.endpoint, retry=ark.NO_RETRY,
+                         failover_s=0.0)
+        with pytest.raises(RuntimeError, match="NotMaster"):
+            c.get_task()
+        # reads still answer
+        assert c.ha_status()["role"] == "standby"
+        c.close()
+    finally:
+        standby.stop()
+
+
+def test_stale_epoch_replication_stream_rejected(tmp_path):
+    """A deposed primary reconnecting after a blip must never overwrite
+    a node that ruled (or replicated) at a higher epoch — whatever the
+    receiver's role or fence state, a stream below its fencing epoch is
+    a redirect, not an install."""
+    m = Master("127.0.0.1:0").start()
+    try:
+        m.start_standby(lease_s=30.0, auto_promote=False)
+        # the real primary feeds it at epoch 3
+        newer = {"todo": [], "done": [{"task_id": 0, "payload": ["a"],
+                                       "epoch": 1, "num_failure": 0}],
+                 "pending": [], "pass": 0, "dataset_fp": None}
+        status, v = m._h_m_replicate(records=[], epoch=3,
+                                     primary="1.2.3.4:1", lease_s=30.0,
+                                     snapshot=newer, base_seq=7)
+        assert status == "ok" and v["applied_seq"] == 7
+        # a STALE predecessor (epoch 1) reconnects with its old state
+        stale = {"todo": [{"task_id": 0, "payload": ["a"], "epoch": 0,
+                           "num_failure": 0}],
+                 "pending": [], "done": [], "pass": 0, "dataset_fp": None}
+        status, v = m._h_m_replicate(records=[], epoch=1,
+                                     primary="5.6.7.8:1", lease_s=30.0,
+                                     snapshot=stale, base_seq=99)
+        assert status == "redirect" and v["epoch"] == 3
+        with m._lock:
+            assert len(m._done) == 1     # the newer state survived
+        assert m._primary_endpoint == "1.2.3.4:1"
+    finally:
+        m.stop()
+
+
+def test_master_pair_without_quorum_crash_stop_promotes(tmp_path):
+    """No arbiters configured: the pair keeps the documented crash-stop
+    model — lease-expiry auto-promotion, epoch bumped."""
+    standby = Master("127.0.0.1:0").start()
+    standby.start_standby(lease_s=0.4)
+    primary = Master("127.0.0.1:0").start()
+    primary.start_replication(standby.endpoint, lease_s=0.4)
+    try:
+        c = MasterClient(primary.endpoint, standbys=[standby.endpoint],
+                         failover_s=10.0)
+        c.set_dataset(["a", "b"])
+        s, t = c.get_task()
+        assert s == "ok"
+        primary.stop()
+        deadline = time.monotonic() + 8
+        while standby.ha_status()["role"] != "primary":
+            assert time.monotonic() < deadline, standby.ha_status()
+            time.sleep(0.02)
+        assert c.task_finished(t["task_id"], t["epoch"]) is True
+        c.close()
+    finally:
+        primary.stop()
+        standby.stop()
+
+
+# ---------------------------------------------------------------------------
+# scale-UP: barrier growth + heartbeat admission
+# ---------------------------------------------------------------------------
+
+def test_evicting_barrier_join_is_next_generation():
+    """join() while a generation is in flight defers admission to the
+    boundary — the world NEVER grows mid-batch."""
+    b = EvictingBarrier(2)
+    results = []
+
+    def waiter(member):
+        results.append((member, b.wait(timeout=10.0, member=member)))
+
+    th0 = threading.Thread(target=waiter, args=(0,), daemon=True)
+    th0.start()
+    deadline = time.monotonic() + 5
+    while b._arrived < 1:                 # generation now in flight
+        assert time.monotonic() < deadline
+        time.sleep(0.005)
+    assert b.join(7) is True              # deferred: mid-generation
+    assert b.live_parties == 2            # unchanged until the boundary
+    th1 = threading.Thread(target=waiter, args=(1,), daemon=True)
+    th1.start()
+    th0.join(timeout=5)
+    th1.join(timeout=5)
+    assert len(results) == 2              # gen completed at the OLD size
+    assert b.live_parties == 3            # admission landed at the edge
+    # idle barrier: immediate admission
+    assert b.join(8) is True
+    assert b.live_parties == 4
+    # joining twice is a no-op; evicting a pending joiner cancels the
+    # admission instead of shrinking a world it never grew
+    assert b.join(8) is False
+    b2 = EvictingBarrier(1)
+    b2._arrived = 1                       # simulate an in-flight gen
+    assert b2.join(9) is True
+    assert 9 in b2._joining
+    assert b2.evict(9) is True
+    assert 9 not in b2._joining and b2.live_parties == 1
+    b2._arrived = 0
+    # a joiner evicted before its boundary is a normal EVICTED member:
+    # its next heartbeat readmits it (no permanent lockout), growing
+    # the live world by the admission it was owed
+    assert 9 in b2.evicted
+    assert b2.readmit(9) is True
+    assert b2.live_parties == 2
+
+
+def test_heartbeat_admits_new_trainer_and_world_grows():
+    """Server-level scale-up: a NEVER-SEEN trainer id heartbeating in
+    is admitted, the sync world grows, and a full-world batch applies
+    averaged over the grown world."""
+    fluid.set_flag("observe", True)
+    from paddle_tpu.observe import metrics as obs_metrics
+    obs_metrics.default_registry().reset()
+    srv = ParameterServer("127.0.0.1:0", trainers=1).start()
+    ep = srv.endpoint
+    c = PSClient([ep])
+    try:
+        c.init_param(ep, "w", np.zeros(4, np.float32), "sgd", 1.0, {})
+        c.heartbeat(ep, trainer_id=0, session="s0", lease_s=5.0)
+        assert srv._sync_barrier.live_parties == 1
+        # trainer 5 was never part of this world
+        c.heartbeat(ep, trainer_id=5, session="s5", lease_s=5.0)
+        assert srv._sync_barrier.live_parties == 2
+        adm = obs_metrics.default_registry().get(
+            "pserver_trainers_admitted_total")
+        assert adm is not None and adm.total() == 1
+        # repeated beats do NOT grow the world again
+        c.heartbeat(ep, trainer_id=5, session="s5", lease_s=5.0)
+        assert srv._sync_barrier.live_parties == 2
+
+        # a 2-party batch: both must arrive, update averages over 2
+        c.push_grads_sync({ep: {"w": np.full(4, 2.0, np.float32)}},
+                          batch_id=0, trainer_id=0, session="s0")
+        c.push_grads_sync({ep: {"w": np.full(4, 4.0, np.float32)}},
+                          batch_id=0, trainer_id=5, session="s5")
+        done = []
+
+        def arrive(tid):
+            c2 = PSClient([ep])
+            c2.sync_apply([ep], trainer_id=tid)
+            done.append(tid)
+            c2.close()
+
+        th = threading.Thread(target=arrive, args=(5,), daemon=True)
+        th.start()
+        time.sleep(0.2)
+        assert not done                   # barrier waits for BOTH
+        c.sync_apply([ep], trainer_id=0)
+        th.join(timeout=10)
+        assert sorted(done) == [5]
+        np.testing.assert_allclose(c.get_param(ep, "w"),
+                                   np.full(4, -3.0, np.float32))
+        c.close()
+    finally:
+        fluid.set_flag("observe", False)
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# observability: detectors, metrics, pulse
+# ---------------------------------------------------------------------------
+
+def test_task_starvation_and_discard_detectors():
+    from paddle_tpu.observe import health as obs_health
+    from paddle_tpu.observe import metrics as obs_metrics
+
+    fluid.set_flag("observe", True)
+    reg = obs_metrics.default_registry()
+    reg.reset()
+    engine = obs_health.HealthEngine()
+    starv = obs_health.TaskStarvationDetector(window_s=0.2)
+    disc = obs_health.TaskDiscardDetector()
+    engine.add_detector(starv)
+    engine.add_detector(disc)
+    try:
+        now = time.time()
+        # no outstanding work: quiet
+        assert engine.evaluate(now) == []
+        # outstanding work + recent progress: quiet
+        reg.gauge("master_tasks_todo", "t").set(5.0, endpoint="m")
+        reg.gauge("master_tasks_pending", "t").set(1.0, endpoint="m")
+        engine.feed("master_task_progress", 1.0)
+        assert not engine.evaluate(time.time())
+        # progress stops for the window while work is outstanding: fire
+        time.sleep(0.3)
+        alerts = {a.rule for a in engine.evaluate(time.time())}
+        assert "task_starvation" in alerts
+        # progress resumes: self-clears
+        engine.feed("master_task_progress", 1.0)
+        assert "task_starvation" not in {
+            a.rule for a in engine.evaluate(time.time())}
+
+        # discard detector: discards that PRE-DATE the plane arming are
+        # baselined, not alerted — a fresh engine's first check sees the
+        # existing count as history
+        reg.counter("master_tasks_discarded_total", "d").inc(2)
+        engine2 = obs_health.HealthEngine()
+        engine2.add_detector(obs_health.TaskDiscardDetector())
+        assert "task_discard" not in {
+            a.rule for a in engine2.evaluate(time.time())}   # baselined
+        # NEW discards while armed fire, sticky
+        reg.counter("master_tasks_discarded_total", "d").inc()
+        assert "task_discard" in {
+            a.rule for a in engine2.evaluate(time.time())}
+        assert "task_discard" in {
+            a.rule for a in engine2.evaluate(time.time())}
+        engine2.clear_alerts()
+        assert "task_discard" not in {
+            a.rule for a in engine2.evaluate(time.time())}
+    finally:
+        fluid.set_flag("observe", False)
+        reg.reset()
+
+
+def test_master_metrics_and_pulse(tmp_path):
+    import urllib.request
+
+    from paddle_tpu.observe import health as obs_health
+    from paddle_tpu.observe import metrics as obs_metrics
+    from paddle_tpu.observe import pulse as obs_pulse
+
+    fluid.set_flag("observe", True)
+    obs_metrics.default_registry().reset()
+    obs_health.reset()
+    m = Master("127.0.0.1:0", timeout_dur=0.3, failure_max=0,
+               check_interval=0.05, pulse_port=0).start()
+    try:
+        assert m.pulse_port
+        c = MasterClient(m.endpoint)
+        c.set_dataset(list(range(4)), chunks_per_task=1)
+        _, t = c.get_task()
+        c.task_finished(t["task_id"], t["epoch"])
+        _, t = c.get_task()
+        c.task_failed(t["task_id"], t["epoch"])   # failure_max=0: discard
+        reg = obs_metrics.default_registry()
+        assert reg.get("master_tasks_issued_total").total() == 2
+        assert reg.get("master_tasks_finished_total").total() == 1
+        assert reg.get("master_tasks_discarded_total").total() == 1
+        assert reg.get("master_tasks_todo").value(
+            endpoint=m.endpoint) == 2.0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{m.pulse_port}/healthz",
+                timeout=10) as r:
+            doc = json.loads(r.read())
+        key = f"master_queues@{m.endpoint}"
+        assert key in doc["checks"]
+        detail = doc["checks"][key]["detail"]
+        assert detail["role"] == "solo" and detail["issuing"] is True
+        assert detail["todo"] == 2 and detail["done"] == 2
+        c.close()
+    finally:
+        m.stop()
+        obs_pulse.stop_pulse()
+        obs_health.reset()
+        obs_metrics.default_registry().reset()
+        fluid.set_flag("observe", False)
+
+
+# ---------------------------------------------------------------------------
+# slow CI wrappers: the three fluid-elastic drills, 3/3 seeds each
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario", ["master_kill", "master_partition",
+                                      "trainer_churn"])
+def test_elastic_drills_three_seeds(tmp_path, scenario):
+    """fluid-elastic CI gate: per-record exactly-once accounting, at
+    most one task-issuing master at every sample, replacement trainer
+    admitted, final loss in the no-fault band — 3/3 seeds (the drill
+    asserts the details; see tools/chaos_drill.py)."""
+    import subprocess
+    import sys
+    for seed in (5, 6, 7):
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "chaos_drill.py"),
+             "--scenario", scenario, "--seed", str(seed),
+             "--workdir", str(tmp_path / f"{scenario}_{seed}")],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, (scenario, seed,
+                                      proc.stdout[-2000:],
+                                      proc.stderr[-2000:])

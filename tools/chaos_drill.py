@@ -55,6 +55,31 @@ Scenarios (all seed-deterministic through ark.chaos):
                   failed trainer steps, exactly ONE lease-holder at
                   every sampled instant, exact update continuity across
                   the flip, and the handover promotion metered
+    master_kill   fluid-elastic: SIGKILL the PRIMARY data master of a
+                  quorum-armed HA pair while consumers stream records;
+                  PASS = the standby promotes inside the lease budget,
+                  zero consumer-visible failures (stall bounded by the
+                  blip), at most ONE task-issuing master at every 5ms
+                  sample, every record delivered with exactly-once
+                  accounting (single-issue tasks delivered exactly
+                  once; duplicates only from failure-budget re-issues)
+    master_partition  fluid-elastic: the primary master is cut from its
+                  standby and from 2/3 arbiters (it keeps the minority)
+                  while consumers reach everyone; PASS = the minority
+                  primary fences then steps down (its stale replies are
+                  redirects, never mutations), the majority-side standby
+                  promotes, consumers follow the quorum holder, at most
+                  one issuing master at every sample, exactly-once
+                  accounting as in master_kill
+    trainer_churn fluid-elastic scale-down AND scale-UP: 3 sync-PS
+                  trainers stream master-leased batches; one is killed
+                  mid-pass (world degrades 3→2 in lease-time) and a
+                  REPLACEMENT with a fresh trainer id is started mid-job
+                  (admitted at the next barrier epoch, world 2→3, pulls
+                  current params before its first push); PASS = world
+                  size observed 3→2→3, every record processed exactly
+                  once up to the failure-budget re-issue, final loss in
+                  the no-fault band, zero trainer-visible failures
     ps_partition  fluid-quorum: ASYMMETRIC partition of a quorum-armed
                   haven pair under async AND sync PS — the primary is
                   cut from its backup and from a majority of the three
@@ -1179,8 +1204,561 @@ def drill_ps_handover(seed, workdir, trace_out=None):
             s.stop()
 
 
+# -- fluid-elastic: HA data plane -----------------------------------------
+
+def _master_ha_world(workdir, lease_s=0.5, timeout_dur=5.0):
+    """3 arbiters + primary/standby master pair (quorum-fenced)."""
+    from paddle_tpu.master import Master
+    from paddle_tpu.quorum import QuorumNode
+
+    qdir = os.path.join(workdir, "mq")
+    nodes = [QuorumNode("127.0.0.1:0", qdir, node_id=f"mn{i}").start()
+             for i in range(3)]
+    qeps = [n.endpoint for n in nodes]
+    standby = Master("127.0.0.1:0",
+                     snapshot_path=os.path.join(workdir, "standby.json"),
+                     timeout_dur=timeout_dur, check_interval=0.1).start()
+    standby.start_standby(lease_s=lease_s, quorum_endpoints=qeps,
+                          quorum_resource="master0")
+    primary = Master("127.0.0.1:0",
+                     snapshot_path=os.path.join(workdir, "primary.json"),
+                     timeout_dur=timeout_dur, check_interval=0.1).start()
+    primary.start_replication(standby.endpoint, lease_s=lease_s,
+                              quorum_endpoints=qeps,
+                              quorum_resource="master0")
+    return nodes, qeps, primary, standby
+
+
+def _run_master_consumers(primary, standby, qeps, n_consumers=2,
+                          item_sleep=0.02):
+    """Consumer threads streaming master-leased records; returns the
+    shared bookkeeping the checks read. Each delivered payload item and
+    each successful RPC timestamp is recorded — the blip measurement."""
+    import threading
+
+    from paddle_tpu.master import MasterClient
+
+    lock = threading.Lock()
+    state = {"deliveries": [], "failures": [], "op_times": [],
+             "threads": [], "lock": lock}
+
+    def consumer(cid):
+        mc = MasterClient(primary.endpoint, standbys=[standby.endpoint],
+                          quorum_endpoints=qeps, quorum_resource="master0",
+                          failover_s=20.0)
+        try:
+            while True:
+                status, task = mc.get_task()
+                with lock:
+                    state["op_times"].append(time.monotonic())
+                if status == "no_more":
+                    return
+                if status == "none":
+                    time.sleep(0.05)
+                    continue
+                for item in task["payload"]:
+                    time.sleep(item_sleep)       # "process" the record
+                    with lock:
+                        state["deliveries"].append(item)
+                mc.task_finished(task["task_id"], task["epoch"])
+                with lock:
+                    state["op_times"].append(time.monotonic())
+        except Exception as e:                   # noqa: BLE001
+            with lock:
+                state["failures"].append((cid, repr(e)))
+        finally:
+            mc.close()
+
+    for cid in range(n_consumers):
+        th = threading.Thread(target=consumer, args=(cid,), daemon=True)
+        state["threads"].append(th)
+        th.start()
+    return state
+
+
+def _check_master_exactly_once(ruler, deliveries, n_items):
+    """Exactly-once accounting: every payload item delivered >= 1, and
+    an item is delivered MORE than once only when its task was
+    re-issued (task epoch > 1 — the documented failure-budget path)."""
+    from collections import Counter
+
+    counts = Counter(deliveries)
+    missing = [i for i in range(n_items) if counts[i] == 0]
+    _check(not missing, f"every record delivered ({len(missing)} missing)")
+    reissued = 0
+    with ruler._lock:
+        done = list(ruler._done)
+    dup_violations = []
+    for t in done:
+        if t.epoch > 1:
+            reissued += 1
+            continue
+        for item in t.payload:
+            if counts[item] != 1:
+                dup_violations.append((item, counts[item]))
+    _check(not dup_violations,
+           f"single-issue tasks delivered EXACTLY once "
+           f"({dup_violations[:3] if dup_violations else 'clean'}; "
+           f"{reissued} re-issued tasks allowed duplicates)")
+
+
+def drill_master_kill(seed, workdir, trace_out=None):
+    """fluid-elastic: SIGKILL the primary data master mid-pass (see
+    module docstring)."""
+    import threading
+
+    from paddle_tpu.observe import flight as obs_flight
+
+    LEASE = 0.5
+    N_ITEMS, CHUNK = 60, 2                      # 30 tasks
+    fluid.set_flag("observe", True)
+    obs_metrics.default_registry().reset()
+    from paddle_tpu.master import MasterClient
+    nodes, qeps, primary, standby = _master_ha_world(workdir,
+                                                     lease_s=LEASE)
+    stop_sampling = threading.Event()
+    state = None
+    try:
+        admin = MasterClient(primary.endpoint)
+        admin.set_dataset(list(range(N_ITEMS)), chunks_per_task=CHUNK)
+        admin.close()
+
+        violations = []
+
+        def sample_issuing():
+            while not stop_sampling.is_set():
+                acc = [primary.issuing, standby.issuing]
+                if sum(acc) > 1:
+                    violations.append(list(acc))
+                time.sleep(0.005)
+
+        threading.Thread(target=sample_issuing, daemon=True).start()
+        state = _run_master_consumers(primary, standby, qeps)
+
+        # let roughly a third of the pass complete at the primary
+        deadline = time.monotonic() + 30
+        while True:
+            with primary._lock:
+                done = len(primary._done)
+            if done >= 10:
+                break
+            if time.monotonic() > deadline:
+                raise DrillFailure("pass made no progress at the primary")
+            time.sleep(0.02)
+
+        kill_at = time.monotonic()
+        chaos.kill_master(primary)
+        print(f"  SIGKILL'd primary master {primary.endpoint} "
+              f"({done} tasks done)")
+        budget_s = LEASE + LEASE / 3.0 + 2.0    # expiry + poll + grants
+        while standby.ha_status()["role"] != "primary":
+            if time.monotonic() - kill_at > budget_s + 5.0:
+                raise DrillFailure(
+                    f"standby never promoted ({standby.ha_status()})")
+            time.sleep(0.01)
+        took = time.monotonic() - kill_at
+        _check(took <= budget_s,
+               f"standby promoted in {took:.2f}s (lease budget "
+               f"~{budget_s:.1f}s)")
+
+        for th in state["threads"]:
+            th.join(timeout=60)
+        _check(all(not th.is_alive() for th in state["threads"]),
+               "both consumers drained the pass")
+        stop_sampling.set()
+        _check(not state["failures"],
+               f"zero consumer-visible failures "
+               f"({state['failures'][:2] if state['failures'] else 'clean'})")
+        # the stall is bounded by the blip: the largest gap between
+        # consecutive successful ops must not exceed the failover budget
+        ops = sorted(state["op_times"])
+        gaps = [b - a for a, b in zip(ops, ops[1:])]
+        blip = max(gaps) if gaps else 0.0
+        _check(blip <= budget_s + 2.0,
+               f"max consumer stall {blip:.2f}s bounded by the failover "
+               f"blip (budget ~{budget_s:.1f}s)")
+        _check(not violations,
+               f"at most one task-issuing master at every 5ms sample")
+        st = standby.ha_status()
+        _check(st["done"] == N_ITEMS // CHUNK and st["todo"] == 0
+               and st["pending"] == 0,
+               f"pass complete at the promoted master ({st})")
+        _check_master_exactly_once(standby, state["deliveries"], N_ITEMS)
+        promoted = obs_metrics.default_registry().get(
+            "master_promotions_total")
+        _check(promoted is not None
+               and promoted.value(kind="quorum") >= 1,
+               "quorum promotion metered")
+        promos = obs_flight.get_flight().events("master_promotion")
+        _check(any(e.get("endpoint") == standby.endpoint for e in promos),
+               "promotion in the flight recorder")
+    finally:
+        stop_sampling.set()
+        fluid.set_flag("observe", False)
+        primary.stop()
+        standby.stop()
+        for n in nodes:
+            n.stop()
+
+
+def drill_master_partition(seed, workdir, trace_out=None):
+    """fluid-elastic: asymmetric partition of the master pair — the
+    minority primary fences, trainers follow the quorum holder (see
+    module docstring)."""
+    import threading
+
+    from paddle_tpu.ark.retry import NO_RETRY
+
+    LEASE = 0.5
+    N_ITEMS, CHUNK = 60, 2
+    fluid.set_flag("observe", True)
+    obs_metrics.default_registry().reset()
+    from paddle_tpu.master import MasterClient
+    nodes, qeps, primary, standby = _master_ha_world(workdir,
+                                                     lease_s=LEASE)
+    stop_sampling = threading.Event()
+    net, state = None, None
+    try:
+        admin = MasterClient(primary.endpoint)
+        admin.set_dataset(list(range(N_ITEMS)), chunks_per_task=CHUNK)
+        admin.close()
+
+        violations = []
+
+        def sample_issuing():
+            while not stop_sampling.is_set():
+                acc = [primary.issuing, standby.issuing]
+                if sum(acc) > 1:
+                    violations.append(list(acc))
+                time.sleep(0.005)
+
+        threading.Thread(target=sample_issuing, daemon=True).start()
+        state = _run_master_consumers(primary, standby, qeps)
+
+        deadline = time.monotonic() + 30
+        while True:
+            with primary._lock:
+                done = len(primary._done)
+            if done >= 8:
+                break
+            if time.monotonic() > deadline:
+                raise DrillFailure("pass made no progress at the primary")
+            time.sleep(0.02)
+
+        # the asymmetric cut: pair severed; primary keeps ONE arbiter
+        # (minority), standby keeps all three; consumers reach everyone
+        net = chaos.NetPartition(seed=seed).start()
+        net.isolate(primary.endpoint, standby.endpoint)
+        net.block(primary.endpoint, qeps[1])
+        net.block(primary.endpoint, qeps[2])
+        cut_at = time.monotonic()
+        print(f"  partition up: primary sees 1/3 arbiters, standby 3/3, "
+              f"pair severed ({done} tasks done)")
+        budget_s = LEASE + LEASE / 3.0 + 2.0
+        while standby.ha_status()["role"] != "primary":
+            if time.monotonic() - cut_at > budget_s + 5.0:
+                raise DrillFailure(
+                    f"majority-side standby never promoted "
+                    f"({standby.ha_status()})")
+            time.sleep(0.01)
+        took = time.monotonic() - cut_at
+        _check(took <= budget_s,
+               f"majority-side promotion in {took:.2f}s (budget "
+               f"~{budget_s:.1f}s)")
+        t0 = time.monotonic()
+        while primary.issuing:
+            if time.monotonic() - t0 > budget_s + 5.0:
+                raise DrillFailure("minority primary never fenced")
+            time.sleep(0.01)
+        print(f"  minority primary fenced/stepped down "
+              f"(role {primary.ha_status()['role']})")
+
+        # a stale client still holding the deposed primary must get a
+        # rejection (redirect -> NotMaster), never a state mutation
+        raw = MasterClient(primary.endpoint, retry=NO_RETRY,
+                           failover_s=0.5)
+        rejected = False
+        try:
+            raw.get_task()
+        except (RuntimeError, ConnectionError, OSError) as e:
+            rejected = "NotMaster" in str(e) or "redirect" in str(e) \
+                or isinstance(e, (ConnectionError, OSError))
+            print(f"  stale get_task at the deposed primary rejected: "
+                  f"{str(e)[:80]}")
+        raw.close()
+        _check(rejected, "deposed primary rejects task commands")
+
+        for th in state["threads"]:
+            th.join(timeout=60)
+        _check(all(not th.is_alive() for th in state["threads"]),
+               "consumers drained the pass following the quorum holder")
+        stop_sampling.set()
+        net.heal()
+        _check(not state["failures"],
+               f"zero consumer-visible failures "
+               f"({state['failures'][:2] if state['failures'] else 'clean'})")
+        _check(not violations,
+               "at most one task-issuing master at every 5ms sample")
+        st = standby.ha_status()
+        _check(st["done"] == N_ITEMS // CHUNK and st["todo"] == 0
+               and st["pending"] == 0,
+               f"pass complete at the promoted master ({st})")
+        _check_master_exactly_once(standby, state["deliveries"], N_ITEMS)
+        reg = obs_metrics.default_registry()
+        promoted = reg.get("master_promotions_total")
+        _check(promoted is not None
+               and promoted.value(kind="quorum") >= 1,
+               "quorum promotion metered")
+        stepdowns = reg.get("master_step_downs_total")
+        _check(stepdowns is not None and stepdowns.total() >= 1,
+               "minority step-down metered")
+    finally:
+        stop_sampling.set()
+        if net is not None:
+            net.stop()
+        fluid.set_flag("observe", False)
+        primary.stop()
+        standby.stop()
+        for n in nodes:
+            n.stop()
+
+
+def _build_sync_member(eps, seed, trainer_id, trainers, lease_s,
+                       lr=0.1):
+    """One sync-PS trainer world (own program/scope/executor) with its
+    step PRE-COMPILED outside the barrier loop (two concurrent first
+    compiles on a contended box can outlast the barrier)."""
+    from paddle_tpu.pserver import SyncPSTrainer
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="int64")
+        h = layers.fc(input=x, size=16, act="relu")
+        logits = layers.fc(input=h, size=2, act=None)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    main.random_seed = startup.random_seed = seed
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.runtime = "pserver"
+    t = fluid.DistributeTranspiler(cfg)
+    t.transpile(trainer_id=trainer_id, program=main, pservers=eps,
+                trainers=trainers, sync_mode=True)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    # pre-compile with the exact (feed, fetch) signature tr.step uses
+    grad_fetches = [t.grad_names[p] for p in t.param_specs]
+    rng = np.random.RandomState(0)
+    exe.run(main, feed={"x": rng.randn(4, 8).astype(np.float32),
+                        "y": np.zeros((4, 1), np.int64)},
+            fetch_list=[loss] + grad_fetches, scope=scope)
+    tr = SyncPSTrainer(t, exe, program=main, scope=scope,
+                       heartbeat_lease_s=lease_s)
+    tr.init_params()               # first writer wins
+    return tr, loss
+
+
+def drill_trainer_churn(seed, workdir, trace_out=None):
+    """fluid-elastic scale-down AND scale-up: kill 1-of-3 sync trainers
+    mid-pass, start a replacement with a FRESH trainer id (see module
+    docstring)."""
+    import threading
+
+    from paddle_tpu.master import Master, MasterClient
+    from paddle_tpu.pserver import ParameterServer
+
+    N_BATCH = 60
+    LEASE = 0.5
+    RECORD_S = 0.05   # per-record pacing: the pass must outlive the
+    #                   churn window so the replacement gets real work
+
+    def batch_of(i, n=32):
+        rng = np.random.RandomState(seed * 1000 + i)
+        w_true = np.random.RandomState(seed + 1).randn(8, 2)
+        xs = rng.randn(n, 8).astype(np.float32)
+        ys = (xs @ w_true).argmax(1).astype(np.int64).reshape(n, 1)
+        return {"x": xs, "y": ys}
+
+    def run(churn):
+        srv = ParameterServer("127.0.0.1:0", trainers=3).start()
+        master = Master("127.0.0.1:0", timeout_dur=4.0,
+                        check_interval=0.1).start()
+        admin = MasterClient(master.endpoint)
+        admin.set_dataset(list(range(N_BATCH)))
+        lock = threading.Lock()
+        deliveries, losses, failures = [], [], []
+        kill_evt = threading.Event()
+        stop_sampling = threading.Event()
+        world_sizes = []
+
+        def sample_world():
+            while not stop_sampling.is_set():
+                w = srv._sync_barrier.live_parties
+                if not world_sizes or world_sizes[-1] != w:
+                    world_sizes.append(w)
+                time.sleep(0.01)
+
+        def consumer(tid, tr, loss, die=False):
+            mc = MasterClient(master.endpoint)
+            killed = False
+            try:
+                while True:
+                    if die and kill_evt.is_set():
+                        killed = True
+                        return
+                    status, task = mc.get_task()
+                    if status == "no_more":
+                        return
+                    if status == "none":
+                        time.sleep(0.05)
+                        continue
+                    for i in task["payload"]:
+                        if die and kill_evt.is_set():
+                            killed = True
+                            return   # dies HOLDING the lease
+                        l, = tr.step(batch_of(i), fetch_list=[loss])
+                        time.sleep(RECORD_S)
+                        with lock:
+                            deliveries.append((tid, i))
+                            losses.append(
+                                float(np.asarray(l).reshape(-1)[0]))
+                    mc.task_finished(task["task_id"], task["epoch"])
+            except Exception as e:               # noqa: BLE001
+                with lock:
+                    failures.append((tid, repr(e)))
+            finally:
+                if killed:
+                    # SIGKILL analog: the heartbeat dies with the
+                    # process — no clean close, the lease just expires
+                    tr._heartbeat.stop()
+                    tr._hb_client.close()
+                else:
+                    tr.close()
+                mc.close()
+
+        threads = []
+        try:
+            # builds are SEQUENTIAL (program construction shares the
+            # global unique-name state); only the loops run concurrently
+            members = [( tid, *_build_sync_member(
+                srv.endpoint, seed, tid, trainers=3, lease_s=LEASE))
+                for tid in range(3)]
+            threading.Thread(target=sample_world, daemon=True).start()
+            for tid, tr, loss in members:
+                th = threading.Thread(
+                    target=consumer, args=(tid, tr, loss),
+                    kwargs={"die": churn and tid == 1}, daemon=True)
+                threads.append(th)
+                th.start()
+            if churn:
+                # let the pass get going, then SIGKILL trainer 1
+                deadline = time.monotonic() + 60
+                while True:
+                    with lock:
+                        n = len(deliveries)
+                    if n >= 5:
+                        break
+                    if time.monotonic() > deadline:
+                        raise DrillFailure("pass never got going")
+                    time.sleep(0.02)
+                kill_evt.set()
+                print(f"  killed trainer 1 mid-pass ({n} records in)")
+                # world must degrade to 2 in lease-time
+                t0 = time.monotonic()
+                while srv._sync_barrier.live_parties > 2:
+                    if time.monotonic() - t0 > 30:
+                        raise DrillFailure("dead trainer never evicted")
+                    time.sleep(0.02)
+                print(f"  world degraded to 2 in "
+                      f"{time.monotonic() - t0:.2f}s")
+                # REPLACEMENT with a FRESH id, mid-job (build in the
+                # main thread — construction is not thread-safe)
+                t_adm = time.monotonic()
+                _, tr3, loss3 = (3, *_build_sync_member(
+                    srv.endpoint, seed, 3, trainers=3, lease_s=LEASE))
+                th = threading.Thread(target=consumer,
+                                      args=(3, tr3, loss3), daemon=True)
+                threads.append(th)
+                th.start()
+                t0 = time.monotonic()
+                while srv._sync_barrier.live_parties < 3:
+                    if time.monotonic() - t0 > 30:
+                        raise DrillFailure("replacement never admitted")
+                    time.sleep(0.02)
+                print(f"  replacement (id 3) admitted in "
+                      f"{time.monotonic() - t_adm:.2f}s — world back to 3")
+            for th in threads:
+                th.join(timeout=300)
+            if any(th.is_alive() for th in threads):
+                raise DrillFailure("a trainer never drained the pass")
+            stop_sampling.set()
+            st = admin.stats()
+            return {"deliveries": list(deliveries),
+                    "losses": list(losses), "failures": list(failures),
+                    "world_sizes": list(world_sizes), "stats": st,
+                    "master": master}
+        finally:
+            stop_sampling.set()
+            kill_evt.set()
+            admin.close()
+            srv.stop()
+            if not churn:
+                master.stop()
+
+    fluid.set_flag("observe", True)
+    obs_metrics.default_registry().reset()
+    try:
+        ref = run(churn=False)
+        _check(not ref["failures"], "no-fault reference run clean")
+        band = np.mean(ref["losses"][-6:]) * 1.3 + 0.05
+
+        obs_metrics.default_registry().reset()
+        got = run(churn=True)
+        master = got["master"]
+        try:
+            _check(not got["failures"],
+                   f"zero trainer-visible failures "
+                   f"({got['failures'][:2] if got['failures'] else 'clean'})")
+            # world size observed 3 -> 2 -> 3
+            w = got["world_sizes"]
+            sub, it = [3, 2, 3], iter(w)
+            _check(all(any(x == want for x in it) for want in sub),
+                   f"world size observed 3->2->3 (samples {w})")
+            st = got["stats"]
+            _check(st["done"] == N_BATCH and st["todo"] == 0
+                   and st["pending"] == 0,
+                   f"pass complete ({st})")
+            by_replacement = sum(1 for tid, _i in got["deliveries"]
+                                 if tid == 3)
+            _check(by_replacement >= 1,
+                   f"replacement trainer processed real work "
+                   f"({by_replacement} records)")
+            _check_master_exactly_once(
+                master, [i for _tid, i in got["deliveries"]], N_BATCH)
+            _check(np.isfinite(got["losses"]).all(), "all losses finite")
+            tail = np.mean(got["losses"][-6:])
+            _check(tail < band,
+                   f"final loss {tail:.4f} inside the no-fault band "
+                   f"(<{band:.4f})")
+            reg = obs_metrics.default_registry()
+            evicted = reg.get("pserver_trainers_evicted_total")
+            _check(evicted is not None and evicted.total() >= 1,
+                   "eviction metered")
+            admitted = reg.get("pserver_trainers_admitted_total")
+            _check(admitted is not None and admitted.total() >= 1,
+                   "scale-up admission metered")
+        finally:
+            master.stop()
+    finally:
+        fluid.set_flag("observe", False)
+
+
 SCENARIOS = {
     "flaky_rpc": drill_flaky_rpc,
+    "master_kill": drill_master_kill,
+    "master_partition": drill_master_partition,
+    "trainer_churn": drill_trainer_churn,
     "ps_primary_kill": drill_ps_primary_kill,
     "ps_handover": drill_ps_handover,
     "ps_partition": drill_ps_partition,
