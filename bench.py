@@ -300,6 +300,72 @@ def _tool_json(script, label, args=(), timeout=600):
         return None, None
 
 
+# every segment label, in run order — the vocabulary for --segments /
+# --skip-segments (prefix match, so `--segments transformer` selects the
+# whole family and `--skip-segments quorum,elastic` drops two planes)
+BENCH_SEGMENTS = (
+    "peak_probe",
+    "transformer256_unfused", "transformer256_flash",
+    "resnet50",
+    "transformer2048_unfused", "transformer2048_flash",
+    "transformer4096_unfused", "transformer4096_flash",
+    "feeder_overlap_subprocess",
+    "stacked_lstm",
+    "step_overhead_subprocess",
+    "op_cost_subprocess",
+    "serve_loadgen_subprocess",
+    "decode_loadgen_subprocess",
+    "fleet_subprocess",
+    "wire_bench_subprocess",
+    "haven_subprocess",
+    "quorum_subprocess",
+    "elastic_subprocess",
+    "transformer256_remeasure",
+    "resnet50_remeasure",
+    "planner_subprocess",
+    "tpu_gated_tests",
+)
+
+
+def _parse_bench_args(argv=None):
+    """Segment selection + the per-segment time budget (BENCH_r05: the
+    driver's watchdog killed a whole run at rc=124 with nothing
+    recorded — a bounded budget per segment and the ability to carve
+    the run into driver-sized pieces are the fix). Flags default from
+    the BENCH_* environment so existing drivers keep working unchanged."""
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="paddle_tpu benchmark driver (one JSON line on "
+                    "stdout; deselected segments record sentinels)")
+    ap.add_argument("--segments",
+                    default=os.environ.get("BENCH_SEGMENTS", ""),
+                    help="comma-separated label prefixes to RUN "
+                         "(empty = all); see --list-segments")
+    ap.add_argument("--skip-segments",
+                    default=os.environ.get("BENCH_SKIP_SEGMENTS", ""),
+                    help="comma-separated label prefixes to skip")
+    ap.add_argument("--segment-budget-s", type=float,
+                    default=float(os.environ.get(
+                        "BENCH_SEGMENT_BUDGET_S", 600)),
+                    help="per-segment wall budget; a segment past it "
+                         "records its sentinel and the run moves on")
+    ap.add_argument("--list-segments", action="store_true",
+                    help="print the segment labels in run order and exit")
+    return ap.parse_args(argv)
+
+
+def _segment_filter(args):
+    want = [s.strip() for s in args.segments.split(",") if s.strip()]
+    skip = [s.strip() for s in args.skip_segments.split(",") if s.strip()]
+
+    def selected(label):
+        if want and not any(label.startswith(w) for w in want):
+            return False
+        return not any(label.startswith(s) for s in skip)
+
+    return selected
+
+
 def feeder_overlap_subprocess():
     """Tunnel-immune AsyncFeeder proof: the demo measures the overlap
     property itself (I/O-bound producer hidden under per-step-synced
@@ -718,7 +784,15 @@ def _emit_partial_and_exit(reason=None):
         os._exit(1)
 
 
-def main():
+def main(argv=None):
+    bench_args = _parse_bench_args(argv)
+    if bench_args.list_segments:
+        for label in BENCH_SEGMENTS:
+            print(label)
+        return
+    _selected = _segment_filter(bench_args)
+    budget_s = max(1.0, bench_args.segment_budget_s)
+
     import jax
     import paddle_tpu as fluid
     from paddle_tpu import models
@@ -816,7 +890,7 @@ def main():
     def note(**kv):
         _PARTIAL["extra"].update(kv)
 
-    def seg(label, fn, default, timeout_s=600):
+    def seg(label, fn, default, timeout_s=None):
         """Fault isolation per sub-bench: a transient infra failure (the
         remote compile server drops connections and occasionally goes
         away entirely mid-run — observed killing a whole bench at the
@@ -825,7 +899,17 @@ def main():
         segment also runs under a SIGALRM hang-breaker (Python-level
         hangs; native hangs fall to the global watchdog). Failed
         segments report their sentinel defaults, which check_claims
-        flags as MEASUREMENT-FAILED."""
+        flags as MEASUREMENT-FAILED. A deselected segment (--segments /
+        --skip-segments) returns its sentinel without running and is
+        listed under skipped_segments — a skip must read as "not
+        measured", never as a zero measurement."""
+        if timeout_s is None:
+            timeout_s = int(budget_s)
+        if not _selected(label):
+            _PARTIAL["extra"].setdefault("skipped_segments",
+                                         []).append(label)
+            return default
+
         def _alarm(signum, frame):
             raise TimeoutError(f"segment exceeded {timeout_s}s")
 
@@ -908,6 +992,8 @@ def main():
         # hours before the first segment
         if os.environ.get("BENCH_SKIP_PEAK", "") == "1":
             raise RuntimeError("BENCH_SKIP_PEAK=1")
+        if not _selected("peak_probe"):
+            raise RuntimeError("peak_probe deselected")
         peak = measure_peak_tflops(jax) * 1e12
     except Exception as e:
         # MFU needs SOME denominator; the measured envelope across
@@ -985,16 +1071,14 @@ def main():
                                   warmup=3), (0.0, 0.0))
     note(transformer_seq4096_flash_tokens_per_sec=round(tok_4k_fus, 0),
          transformer_seq4096_unfused_tokens_per_sec=round(tok_4k_unf, 0))
-    _PARTIAL["extra"]["failure_stage"] = "feeder_overlap_subprocess"
-    _obs.flight.set_stage("feeder_overlap_subprocess")
-    feeder = feeder_overlap_subprocess()
+    feeder = seg("feeder_overlap_subprocess", feeder_overlap_subprocess,
+                 {})
     lstm_tok, lstm_ex = seg(
         "stacked_lstm",
         lambda: bench_stacked_lstm(fluid, models, jax), (0.0, 0.0))
     note(stacked_lstm_examples_per_sec=round(lstm_ex, 1))
-    _PARTIAL["extra"]["failure_stage"] = "step_overhead_subprocess"
-    _obs.flight.set_stage("step_overhead_subprocess")
-    overhead = step_overhead_subprocess()
+    overhead = seg("step_overhead_subprocess", step_overhead_subprocess,
+                   {})
     note(step_overhead_us=overhead.get("step_overhead_us", 0.0),
          step_overhead_us_unprepared=overhead.get(
              "step_overhead_us_unprepared", 0.0),
@@ -1003,52 +1087,37 @@ def main():
     # fluid-serve: p50/p99/qps + the zero-steady-state-recompiles gate
     # (recompiles: 0 = observatory-verified clean run; -1 = the loadgen
     # itself failed to produce numbers)
-    _PARTIAL["extra"]["failure_stage"] = "op_cost_subprocess"
-    _obs.flight.set_stage("op_cost_subprocess")
-    opcost = op_cost_subprocess()
+    opcost = seg("op_cost_subprocess", op_cost_subprocess, {})
     note(**opcost)
-    _PARTIAL["extra"]["failure_stage"] = "serve_loadgen_subprocess"
-    srv = serve_loadgen_subprocess()
+    srv = seg("serve_loadgen_subprocess", serve_loadgen_subprocess, {})
     note(serve_p50_us=srv.get("serve_p50_us", 0.0),
          serve_p99_us=srv.get("serve_p99_us", 0.0),
          serve_qps=srv.get("serve_qps", 0.0),
          serve_recompiles=srv.get("serve_recompiles", -1))
     # fluid-decode: paged-KV continuous batching — decode tokens/s, TTFT
     # p50/p99, and the continuous-vs-drain A/B (acceptance >= 1.3x)
-    _PARTIAL["extra"]["failure_stage"] = "decode_loadgen_subprocess"
-    _obs.flight.set_stage("decode_loadgen_subprocess")
-    dec = decode_loadgen_subprocess()
+    dec = seg("decode_loadgen_subprocess", decode_loadgen_subprocess, {})
     note(**dec)
     # fluid-fleet: multi-replica QPS scaling (subprocess replicas behind
     # the router), skew-free coordinated swap, p99 across a replica
     # SIGKILL with zero failed requests, DeepFM-from-pserver-shards
-    _PARTIAL["extra"]["failure_stage"] = "fleet_subprocess"
-    _obs.flight.set_stage("fleet_subprocess")
-    fleet_rec = fleet_subprocess()
+    fleet_rec = seg("fleet_subprocess", fleet_subprocess, {})
     note(**fleet_rec)
     # fluid-wire: quantized PS wire A/B (bytes/step raw vs encoded, sync-PS
     # step time both modes, sparse-row compression, loss-delta neutrality)
-    _PARTIAL["extra"]["failure_stage"] = "wire_bench_subprocess"
-    _obs.flight.set_stage("wire_bench_subprocess")
-    wirebench = wire_bench_subprocess()
+    wirebench = seg("wire_bench_subprocess", wire_bench_subprocess, {})
     note(**wirebench)
     # fluid-haven: replicated-PS steady-state overhead + failover blip
-    _PARTIAL["extra"]["failure_stage"] = "haven_subprocess"
-    _obs.flight.set_stage("haven_subprocess")
-    havenrec = haven_subprocess()
+    havenrec = seg("haven_subprocess", haven_subprocess, {})
     note(**havenrec)
     # fluid-quorum: lease-renewal overhead on the sync-PS step (<=2%
     # acceptance vs the haven baseline) + the asymmetric-partition
     # failover blip vs the lease+retry budget (quorum_failover_ok)
-    _PARTIAL["extra"]["failure_stage"] = "quorum_subprocess"
-    _obs.flight.set_stage("quorum_subprocess")
-    quorumrec = quorum_subprocess()
+    quorumrec = seg("quorum_subprocess", quorum_subprocess, {})
     note(**quorumrec)
     # fluid-elastic: master-failover blip vs its lease+retry budget +
     # the scale-up admission latency of a new trainer joining mid-job
-    _PARTIAL["extra"]["failure_stage"] = "elastic_subprocess"
-    _obs.flight.set_stage("elastic_subprocess")
-    elasticrec = elastic_subprocess()
+    elasticrec = seg("elastic_subprocess", elastic_subprocess, {})
     note(**elasticrec)
     # the headline pair is drift-sensitive through the dev tunnel, and
     # the noise is ONE-SIDED: a stall can only lower a reading below the
@@ -1090,13 +1159,11 @@ def main():
     # with THIS run's measured peak and the final (keep-the-max) MFU —
     # plan_agreement ~1.0 means the mesh/HBM/flag rankings upstream of
     # auto_mesh are computed from an honest time model
-    _PARTIAL["extra"]["failure_stage"] = "planner_subprocess"
-    _obs.flight.set_stage("planner_subprocess")
-    plan = planner_subprocess(peak / 1e12, tf_fps / peak if peak else 0.0)
+    plan = seg("planner_subprocess",
+               lambda: planner_subprocess(
+                   peak / 1e12, tf_fps / peak if peak else 0.0), {})
     note(**plan)
-    _PARTIAL["extra"]["failure_stage"] = "tpu_gated_tests"
-    _obs.flight.set_stage("tpu_gated_tests")
-    gated = tpu_gated_tests()
+    gated = seg("tpu_gated_tests", tpu_gated_tests, {})
 
     extra = {
         "vs_baseline_note": "reference best is CPU MKL-DNN bs256; "
@@ -1194,9 +1261,9 @@ def main():
     # telemetry accumulated in _PARTIAL plus the whole-run compile story.
     extra["failure_stage"] = (_PARTIAL["extra"].get("failed_stages")
                               or [None])[0]
-    for k in ("failed_stages", "segment_wall_s", "step_phases_us",
-              "recompiles", "mem_peak_est_bytes", "mem_live_bytes",
-              "pulse_port"):
+    for k in ("failed_stages", "skipped_segments", "segment_wall_s",
+              "step_phases_us", "recompiles", "mem_peak_est_bytes",
+              "mem_live_bytes", "pulse_port"):
         if k in _PARTIAL["extra"]:
             extra[k] = _PARTIAL["extra"][k]
     extra["recompile_causes_total"] = _recompile_counts()

@@ -15,6 +15,11 @@ Surfaces wired elsewhere: the read-only "verify" pass and the mutating
 the `validate` flag (core/executor.py, flags.py), transpiler split
 verification (transpiler/distribute_transpiler.py), and the
 `tools/paddle_lint.py` CLI.
+
+A second, source-level surface lives in `concurrency`: an AST-based
+lock-discipline / deadlock-cycle / hold-time analyzer over the repo's
+own threaded planes, exposed through `tools/race_lint.py` (see
+docs/ANALYSIS.md, "Concurrency lint").
 """
 
 from __future__ import annotations
@@ -22,6 +27,8 @@ from __future__ import annotations
 from typing import List, Optional, Sequence
 
 from ..core import ir
+from .concurrency import (ConcurrencyDiagnostic, analyze_package,  # noqa: F401
+                          analyze_paths, analyze_source, baseline_key)
 from .cost_model import (CostReport, OpCost, estimate_cost,  # noqa: F401
                          estimate_peak_hbm, shape_env)
 from .planner import (CPU_REHEARSAL, TPU_CHIP, HardwareSpec,  # noqa: F401

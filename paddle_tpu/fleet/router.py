@@ -133,23 +133,23 @@ class FleetRouter(_wire.HardCutServer):
         self.retry = self.config.retry or RetryPolicy(
             max_attempts=3, base_delay=0.01, max_delay=0.25)
         self._lock = threading.RLock()
-        self._members: Dict[str, _Member] = {}
+        self._members: Dict[str, _Member] = {}  # guarded_by: self._lock
         self._lease = (QuorumLeaseTable(
             quorum=self.config.quorum,
             resource_prefix=self.config.quorum_member_prefix)
             if self.config.quorum is not None else LeaseTable())
-        self._rr = 0
+        self._rr = 0  # guarded_by: self._lock
         # committed fleet version per model (set by swap); gates
         # readiness so a stale replica can never serve mixed versions
-        self._desired: Dict[str, str] = {}
+        self._desired: Dict[str, str] = {}  # guarded_by: self._lock
         # swap gate per model: set() = dispatch open
-        self._gates: Dict[str, threading.Event] = {}
-        self._inflight: Dict[str, int] = {}
+        self._gates: Dict[str, threading.Event] = {}  # guarded_by: self._lock
+        self._inflight: Dict[str, int] = {}  # guarded_by: self._lock
         self._drain = threading.Condition(self._lock)
         # completion sequence: assigned under the lock while the request
         # is STILL in-flight, so swap's drain orders it before every
         # post-reopen request — the skew gate's exact ordering source
-        self._completion_seq = 0
+        self._completion_seq = 0  # guarded_by: self._lock
         self.control_endpoint: Optional[str] = None
         self._poller: Optional[threading.Thread] = None
         self.pulse_port: Optional[int] = None
@@ -279,7 +279,8 @@ class FleetRouter(_wire.HardCutServer):
         """Members allowed to take `model` traffic: live lease, ready
         verdict, not suspect, model present+warmed, and — once a swap
         committed a fleet version — the matching version_key."""
-        want = self._desired.get(model)
+        with self._lock:
+            want = self._desired.get(model)
         out = []
         for m in self._live_members():
             if not m.ready or m.suspect:
@@ -296,7 +297,9 @@ class FleetRouter(_wire.HardCutServer):
 
     def _poll_loop(self):
         while not self._stop.wait(self.config.poll_interval_s):
-            for m in list(self._members.values()):
+            with self._lock:
+                snapshot = list(self._members.values())
+            for m in snapshot:
                 if self._stop.is_set():
                     return
                 self._poll_member(m)
@@ -392,7 +395,9 @@ class FleetRouter(_wire.HardCutServer):
             self._register(p["replica_id"], p["endpoint"],
                            p.get("pulse_port"), p.get("session"),
                            float(p.get("lease_s") or self.config.lease_s))
-            return ("ok", {"members": len(self._members)})
+            with self._lock:
+                n_members = len(self._members)
+            return ("ok", {"members": n_members})
         if cmd == "replica_leave":
             return ("ok", {"removed":
                            self.remove_replica(p["replica_id"])})
@@ -474,9 +479,11 @@ class FleetRouter(_wire.HardCutServer):
                     self._m_requests.inc(model=model, outcome="no_replica")
                     if last_err is not None:
                         raise last_err
+                    with self._lock:
+                        known = sorted(self._members)
                     raise ModelUnavailableError(
                         f"model {model!r}: no ready replica "
-                        f"(members: {sorted(self._members)})")
+                        f"(members: {known})")
                 with self._lock:
                     m.inflight += 1
                 try:
@@ -666,7 +673,11 @@ class FleetRouter(_wire.HardCutServer):
             # the fleet version is now new_key: any replica that failed
             # its flip reports a stale version_key and the readiness
             # gate keeps it out of dispatch until it catches up
-            self._desired[model] = new_key
+            # (under the lock: dispatch threads read it in
+            # ready_members, and the RLock write also publishes the
+            # membership details _poll_member refreshed above)
+            with self._lock:
+                self._desired[model] = new_key
         except FleetError:
             for m in targets:
                 if m.replica_id not in committed:
@@ -704,19 +715,22 @@ class FleetRouter(_wire.HardCutServer):
         for name in models:
             models[name] = len(self.ready_members(name))
         ok = all(n > 0 for n in models.values()) if models else True
+        with self._lock:
+            desired = dict(self._desired)
         return ok, {"ready_by_model": models,
                     "members": {rid: {"ready": m["ready"],
                                       "endpoint": m["endpoint"]}
                                 for rid, m in members.items()},
-                    "desired_versions": dict(self._desired)}
+                    "desired_versions": desired}
 
     def stats(self) -> dict:
         with self._lock:
             inflight = dict(self._inflight)
+            desired = dict(self._desired)
         return {
             "control_endpoint": self.control_endpoint,
             "members": self.members(),
             "inflight": inflight,
-            "desired_versions": dict(self._desired),
+            "desired_versions": desired,
             "ts": time.time(),
         }

@@ -72,7 +72,7 @@ class HardCutServer:
 
     def __init__(self):
         self._listener: Optional[_socket.socket] = None
-        self._conns: set = set()
+        self._conns: set = set()  # guarded_by: self._conns_lock
         self._conns_lock = threading.Lock()
         self._stop = threading.Event()
 

@@ -37,11 +37,12 @@ class UpdateLog:
         self.stall_timeout_s = float(stall_timeout_s)
         self._cond = threading.Condition()
         # list of (seq, cmd, payload, t_monotonic); seqs are contiguous
-        self._records: List[Tuple[int, str, dict, float]] = []
-        self._head = 0      # seq of the newest appended record
-        self._acked = 0     # highest seq the backup acknowledged
-        self._degraded = False
-        self.needs_resync = True   # a fresh pair always starts with a sync
+        self._records: List[Tuple[int, str, dict, float]] = []  # guarded_by: self._cond
+        self._head = 0      # guarded_by: self._cond
+        self._acked = 0     # guarded_by: self._cond
+        self._degraded = False  # guarded_by: self._cond
+        # a fresh pair always starts with a sync
+        self._needs_resync = True  # guarded_by: self._cond
 
     # -- primary write path ----------------------------------------------
     def append(self, cmd: str, payload: dict) -> Optional[int]:
@@ -129,7 +130,7 @@ class UpdateLog:
         availability mode), which is idle, not backlog."""
         with self._cond:
             base = self._head - self._acked
-            if self.needs_resync and not self._degraded:
+            if self._needs_resync and not self._degraded:
                 return max(base, 1)
             return base
 
@@ -145,9 +146,16 @@ class UpdateLog:
         with self._cond:
             return self._degraded
 
+    @property
+    def needs_resync(self) -> bool:
+        """Locked read: the replicator loop and the handover drain poll
+        this from their own threads."""
+        with self._cond:
+            return self._needs_resync
+
     def _degrade_locked(self):
         self._degraded = True
-        self.needs_resync = True
+        self._needs_resync = True
         self._records.clear()
         self._acked = self._head
         self._cond.notify_all()
@@ -178,4 +186,4 @@ class UpdateLog:
         advance the watermark past it and clear the resync flag."""
         with self._cond:
             self._advance_locked(self._head if seq is None else seq)
-            self.needs_resync = False
+            self._needs_resync = False

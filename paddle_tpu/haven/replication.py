@@ -168,8 +168,8 @@ class HavenState:
         self._replay_lock = threading.Lock()
         # serve gate: counts in-flight mutators; `quiesce` holds new ones
         self._gate = threading.Condition()
-        self._active = 0
-        self._held = False
+        self._active = 0  # guarded_by: self._gate
+        self._held = False  # guarded_by: self._gate
         self._replicator: Optional[Replicator] = None
         self._monitor: Optional[threading.Thread] = None
         # fluid-quorum (arm_quorum): the arbiter client, the shard's
@@ -179,9 +179,9 @@ class HavenState:
         self.quorum = None
         self.resource: Optional[str] = None
         self.quorum_lease_s: Optional[float] = None
-        self._qlease = None
+        self._qlease = None  # guarded_by: self._state_lock
         self._renewer: Optional[threading.Thread] = None
-        self._fenced = False
+        self._fenced = False  # guarded_by: self._gate
         self._stop = threading.Event()
         # test hook: raise at a named handover cut point ("pre_promote" /
         # "post_promote") to drill the torn-handoff contract
@@ -382,7 +382,9 @@ class HavenState:
             except Exception:   # noqa: BLE001 — unreachable == failed
                 ok = False
             if ok:
-                if self._fenced:
+                with self._gate:
+                    fenced = self._fenced
+                if fenced:
                     self._set_fenced(False)
                 continue
             if self.role == "primary":

@@ -59,8 +59,8 @@ class MasterClient:
         self._quorum_resource = quorum_resource
         self._quorum_client = None
         self._primary: Optional[str] = None   # ruling endpoint override
-        self._sock = None
-        self._sock_ep: Optional[str] = None
+        self._sock = None                     # guarded_by: self._lock
+        self._sock_ep: Optional[str] = None   # guarded_by: self._lock
         self._lock = threading.Lock()
 
     # -- transport ---------------------------------------------------------
@@ -81,42 +81,46 @@ class MasterClient:
         deadline_at = None if deadline is None \
             else time.monotonic() + deadline
         attempt = 0
-        with self._lock:
-            while True:
-                try:
+        while True:
+            try:
+                # The lock covers exactly one request/response exchange:
+                # the send/recv pair must be atomic on the shared socket,
+                # but backoff between attempts must not hold it.
+                with self._lock:
                     if self._sock is None or self._sock_ep != ep:
                         self._close_sock_locked()
                         remaining = 30.0 if deadline_at is None else \
                             max(0.05, deadline_at - time.monotonic())
-                        self._sock = rpc.connect(ep, timeout=remaining)
+                        self._sock = rpc.connect(ep, timeout=remaining)  # race_lint: ignore[blocking-under-lock] — single-connection wire serialization; the lock IS the socket's mutual exclusion
                         self._sock_ep = ep
                     if deadline_at is not None:
                         self._sock.settimeout(
                             max(0.05, deadline_at - time.monotonic()))
-                    rpc.send_msg(self._sock, (cmd, payload))
-                    status, value = rpc.recv_msg(self._sock)
+                    rpc.send_msg(self._sock, (cmd, payload))  # race_lint: ignore[blocking-under-lock] — request/response pair must be atomic on the shared socket
+                    status, value = rpc.recv_msg(self._sock)  # race_lint: ignore[blocking-under-lock] — request/response pair must be atomic on the shared socket
                     if deadline_at is not None:
                         self._sock.settimeout(None)
                     return status, value
-                except (ConnectionError, EOFError, OSError,
-                        _socket.timeout):
+            except (ConnectionError, EOFError, OSError,
+                    _socket.timeout):
+                with self._lock:
                     self._close_sock_locked()
-                    out_of_time = deadline_at is not None and \
-                        time.monotonic() >= deadline_at
-                    if attempt >= policy.max_attempts or out_of_time:
-                        raise
-                    if _flags.get_flag("observe"):
-                        _metrics.counter(
-                            "master_client_retries_total",
-                            "master RPC attempts replayed after a "
-                            "transport failure").inc(cmd=cmd)
-                    delay = policy.backoff(attempt)
-                    attempt += 1
-                    if deadline_at is not None:
-                        delay = min(delay, max(
-                            0.0, deadline_at - time.monotonic()))
-                    if delay:
-                        time.sleep(delay)
+                out_of_time = deadline_at is not None and \
+                    time.monotonic() >= deadline_at
+                if attempt >= policy.max_attempts or out_of_time:
+                    raise
+                if _flags.get_flag("observe"):
+                    _metrics.counter(
+                        "master_client_retries_total",
+                        "master RPC attempts replayed after a "
+                        "transport failure").inc(cmd=cmd)
+                delay = policy.backoff(attempt)
+                attempt += 1
+                if deadline_at is not None:
+                    delay = min(delay, max(
+                        0.0, deadline_at - time.monotonic()))
+                if delay:
+                    time.sleep(delay)
 
     def _call(self, cmd, _deadline=..., **payload):
         if _deadline is ...:

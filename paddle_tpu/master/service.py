@@ -123,21 +123,22 @@ class Master:
         self.timeout_dur = timeout_dur
         self.failure_max = failure_max
         self.check_interval = check_interval
-        self._todo: List[_Task] = []
-        self._pending: Dict[int, _Task] = {}
-        self._done: List[_Task] = []
-        self._dataset_fp: Optional[Dict] = None
+        self._todo: List[_Task] = []          # guarded_by: self._lock
+        self._pending: Dict[int, _Task] = {}  # guarded_by: self._lock
+        self._done: List[_Task] = []          # guarded_by: self._lock
+        self._dataset_fp: Optional[Dict] = None  # guarded_by: self._lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._listener: Optional[socket.socket] = None
-        self._conns: set = set()
+        self._conns: set = set()              # guarded_by: self._conns_lock
         self._conns_lock = threading.Lock()
-        self._epoch_pass = 0
+        self._epoch_pass = 0                  # guarded_by: self._lock
         # -- fluid-elastic HA state (all inert for the solo default) ----
         self.role = "solo"            # solo | primary | standby
         self.fence_epoch = 0
         self.lease_s = 2.0
-        self._fenced = False          # primary whose quorum renew fails
+        # primary whose quorum renew fails
+        self._fenced = False          # guarded_by: self._lock
         self._auto_promote = True
         self._standby_endpoint: Optional[str] = None
         self._standby_sock: Optional[socket.socket] = None
@@ -147,15 +148,20 @@ class Master:
         self._quorum_resource = "master"
         self._quorum_lease = None
         self._quorum_thread: Optional[threading.Thread] = None
-        self._ha_seq = 0              # primary: record sequence head
-        self._ha_acked = 0            # primary: standby's applied seq
-        self._ha_log: List = []       # [(seq, record)], bounded
+        # primary: record sequence head
+        self._ha_seq = 0              # guarded_by: self._lock
+        # primary: standby's applied seq
+        self._ha_acked = 0            # guarded_by: self._lock
+        # [(seq, record)], bounded
+        self._ha_log: List = []       # guarded_by: self._lock
         self._ha_log_cap = 1024
-        self._ha_need_snap = False
-        self._ha_degraded = False     # standby unreachable, quorum held
+        self._ha_need_snap = False    # guarded_by: self._lock
+        # standby unreachable, quorum held
+        self._ha_degraded = False     # guarded_by: self._lock
         self._ha_flush_cond = threading.Condition()
         self._ha_dirty = threading.Event()
-        self._applied_seq = 0         # standby: replay watermark
+        # standby: replay watermark
+        self._applied_seq = 0         # guarded_by: self._lock
         self._pulse_port_req = pulse_port
         self.pulse_port: Optional[int] = None
         if snapshot_path and (os.path.exists(snapshot_path)
@@ -169,7 +175,8 @@ class Master:
         master always, a primary only while its quorum lease renews (a
         fenced or deposed primary holds). The chaos drills sample this
         across both members — at most one True at every instant."""
-        return (self.role in ("solo", "primary") and not self._fenced
+        return (self.role in ("solo", "primary")
+                and not self._fenced  # race_lint: ignore[unguarded-read] — deliberately lock-free sampled verdict; callers tolerate one-tick staleness, and the chaos drills sample it at rate
                 and not self._stop.is_set())
 
     # -- metrics (observe-gated; zero writes when the flag is off) ---------
@@ -584,7 +591,11 @@ class Master:
         against the preserved pending lease."""
         deadline = time.monotonic() + self.lease_s
         with self._ha_flush_cond:
-            while self._ha_acked < seq and not self._ha_degraded:
+            while True:
+                with self._lock:
+                    flushed = (self._ha_acked >= seq or self._ha_degraded)
+                if flushed:
+                    break
                 if self._stop.is_set() or self.role != "primary":
                     return False
                 self._ha_dirty.set()
@@ -592,10 +603,15 @@ class Master:
                 if remaining <= 0:
                     break
                 self._ha_flush_cond.wait(min(remaining, 0.05))
-        if self._ha_acked >= seq or self._ha_degraded:
-            return True
-        if self._fenced or self.role != "primary":
-            return False
+        with self._lock:
+            if self._ha_acked >= seq or self._ha_degraded:
+                return True
+            if self._fenced or self.role != "primary":
+                return False
+            # the degrade verdict and the flag flip are one atomic step:
+            # an unlocked write here raced _forward_once's locked
+            # `_ha_degraded = False` on standby recovery
+            self._ha_degraded = True
         logger.warning(
             "master %s: standby unreachable for %.1fs while the quorum "
             "lease still renews — DEGRADING to solo issue (the standby "
@@ -603,7 +619,6 @@ class Master:
             self.endpoint, self.lease_s)
         _flight.note("master_ha_degraded", endpoint=self.endpoint,
                      epoch=self.fence_epoch)
-        self._ha_degraded = True
         return True
 
     def _ha_mark_snapshot_locked(self):
@@ -710,18 +725,22 @@ class Master:
             except Exception as e:   # noqa: BLE001 — renewal best-effort
                 logger.debug("master-quorum: renew failed: %s", e)
             if ok:
-                if self._fenced:
+                with self._lock:
+                    recovered = self._fenced
+                    self._fenced = False
+                if recovered:
                     logger.info("master %s: quorum renew recovered — "
                                 "unfencing", self.endpoint)
-                self._fenced = False
                 continue
-            if not self._fenced:
+            with self._lock:
+                first = not self._fenced
+                self._fenced = True
+            if first:
                 logger.warning("master %s: quorum renew FAILED — fencing "
                                "the task plane (step-down at local "
                                "expiry)", self.endpoint)
                 _flight.note("master_fenced", endpoint=self.endpoint,
                              epoch=self.fence_epoch)
-            self._fenced = True
             if lease is None or not lease.live:
                 self._step_down("quorum_lost", self.fence_epoch)
 
@@ -856,14 +875,15 @@ class Master:
             now = time.time()
             for t in self._pending.values():
                 t.deadline = now + self.timeout_dur
+            n_pending = len(self._pending)
             self._meter_queues_locked()
             self._snapshot_locked()
         logger.warning("master %s: PROMOTED to primary at epoch %d (%s; "
                        "%d pending leases preserved)", self.endpoint,
-                       self.fence_epoch, kind, len(self._pending))
+                       self.fence_epoch, kind, n_pending)
         _flight.note("master_promotion", endpoint=self.endpoint,
                      epoch=self.fence_epoch, promotion=kind,
-                     pending=len(self._pending))
+                     pending=n_pending)
         self._meter(PROMOTIONS_METRIC,
                     "standby masters promoted to primary", kind=kind)
         if self._quorum is not None:

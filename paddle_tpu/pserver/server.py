@@ -84,7 +84,7 @@ class ParameterServer:
         # sync mode (reference RunSyncLoop, listen_and_serv_op.cc:106):
         # per-batch gradient accumulation + a barrier whose action applies
         # the aggregated update ONCE before any trainer proceeds
-        self._pending: Dict[str, np.ndarray] = {}
+        self._pending: Dict[str, np.ndarray] = {}  # guarded_by: self._pending_lock
         self._pending_lock = threading.Lock()
         # exactly-once sync accounting: per-trainer highest APPLIED batch
         # id (keyed under that trainer's session nonce, so a restarted
@@ -94,15 +94,18 @@ class ParameterServer:
         # or already-accumulated batch are acknowledged but NOT
         # re-accumulated (closes the double-advance window on partial
         # barrier failure across servers)
-        self._sync_applied: Dict[int, int] = {}     # trainer -> batch id
-        self._sync_sessions: Dict[int, object] = {}  # trainer -> nonce
-        self._sync_pending_from: set = set()
+        # trainer -> batch id
+        self._sync_applied: Dict[int, int] = {}  # guarded_by: self._pending_lock
+        # trainer -> nonce
+        self._sync_sessions: Dict[int, object] = {}  # guarded_by: self._pending_lock
+        self._sync_pending_from: set = set()  # guarded_by: self._pending_lock
         # exactly-once ASYNC accounting (fluid-haven): tagged barrierless
         # pushes carry a per-trainer monotone seq under a session nonce —
         # the async twin of the sync watermark above, which is what makes
         # a push replayed at a PROMOTED backup safe to ack-and-drop
-        self._async_applied: Dict[int, int] = {}    # trainer -> push seq
-        self._async_sessions: Dict[int, object] = {}
+        # trainer -> push seq
+        self._async_applied: Dict[int, int] = {}  # guarded_by: self._async_lock
+        self._async_sessions: Dict[int, object] = {}  # guarded_by: self._async_lock
         self._async_lock = threading.Lock()
         # fluid-haven replication state (armed by start_replication /
         # start_standby; None = the legacy solo server, zero new cost)
@@ -119,14 +122,15 @@ class ParameterServer:
         # heartbeat from a NEVER-SEEN id is a replacement/extra trainer
         # joining a running job — the barrier grows at the next
         # generation boundary (EvictingBarrier.join), never mid-batch.
-        self._known_members: set = set(range(trainers))
+        self._known_members: set = set(range(trainers))  # guarded_by: self._members_lock
         self._members_lock = threading.Lock()
         self._locks: Dict[str, threading.Lock] = {}
         self._global_lock = threading.Lock()
         self._barrier = threading.Barrier(trainers) if trainers > 1 else None
         self._listener: Optional[socket.socket] = None
         self._threads = []
-        self._conns: set = set()   # live accepted sockets (for hard cut)
+        # live accepted sockets (for hard cut)
+        self._conns: set = set()   # guarded_by: self._conns_lock
         self._conns_lock = threading.Lock()
         self._stop = threading.Event()
 

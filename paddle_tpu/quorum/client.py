@@ -102,8 +102,8 @@ class QuorumClient:
         self.majority = len(self.endpoints) // 2 + 1
         self.deadline_s = float(deadline_s)
         self.connect_timeout_s = float(connect_timeout_s)
-        self._socks: Dict[str, _socket.socket] = {}
-        self._ep_locks: Dict[str, threading.Lock] = {}
+        self._socks: Dict[str, _socket.socket] = {}      # guarded_by: self._lock
+        self._ep_locks: Dict[str, threading.Lock] = {}   # guarded_by: self._lock
         self._lock = threading.Lock()
         self._pool = ThreadPoolExecutor(
             max_workers=max(1, len(self.endpoints)),

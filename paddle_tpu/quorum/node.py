@@ -56,7 +56,7 @@ class QuorumStore:
     def __init__(self, data_dir: str, node_id: str):
         self.path = os.path.join(data_dir, f"{node_id}_{EPOCH_FILE}")
         self._lock = threading.Lock()
-        self._epochs: Dict[str, Dict] = {}
+        self._epochs: Dict[str, Dict] = {}  # guarded_by: self._lock
         self._load()
 
     @staticmethod
@@ -91,7 +91,7 @@ class QuorumStore:
                     f"{self.path} fails its embedded checksum — bit rot; "
                     f"refusing to restart this arbiter at a regressed "
                     f"epoch")
-            self._epochs = {r: dict(rec)
+            self._epochs = {r: dict(rec)  # race_lint: ignore[unguarded-write] — __init__-only load path, pre-publication
                             for r, rec in raw["epochs"].items()}
             try:
                 ark_ckpt.verify_sidecar(self.path)
@@ -102,7 +102,7 @@ class QuorumStore:
             # legacy flat-mapping format: the sidecar is the only
             # verifier
             ark_ckpt.verify_sidecar(self.path)
-            self._epochs = {r: dict(rec) for r, rec in raw.items()}
+            self._epochs = {r: dict(rec) for r, rec in raw.items()}  # race_lint: ignore[unguarded-write] — __init__-only load path, pre-publication
 
     def _commit_locked(self) -> None:
         doc = {"sha256": self._payload_sha(self._epochs),
@@ -181,7 +181,7 @@ class QuorumNode:
                                    else f"q0-{uuid.uuid4().hex[:8]}")
         os.makedirs(data_dir, exist_ok=True)
         self.store = QuorumStore(data_dir, self.node_id)
-        self._leases: Dict[str, _Lease] = {}
+        self._leases: Dict[str, _Lease] = {}  # guarded_by: self._lock
         self._lock = threading.Lock()
         # boot blackout, PER RESOURCE: campaigns for a resource are
         # refused until the longest lease this node had granted on it
@@ -195,7 +195,7 @@ class QuorumNode:
         self._boot_lease_s = {r: self.store.lease_s(r)
                               for r in self.store.resources()}
         self._listener: Optional[socket.socket] = None
-        self._conns: set = set()
+        self._conns: set = set()              # guarded_by: self._conns_lock
         self._conns_lock = threading.Lock()
         self._stop = threading.Event()
 

@@ -148,12 +148,12 @@ class MicroBatcher:
                  max_queue: int = 256):
         self._registry = registry
         self._name = name
-        self._timeout_s = max(batch_timeout_ms, 0.0) / 1e3
+        self._timeout_s = max(batch_timeout_ms, 0.0) / 1e3  # guarded_by: self._cond
         self._max_queue = max_queue
-        self._queues: Dict[Tuple, deque] = {}
+        self._queues: Dict[Tuple, deque] = {}  # guarded_by: self._cond
         self._cond = threading.Condition()
-        self._pending = 0
-        self._closed = False
+        self._pending = 0  # guarded_by: self._cond
+        self._closed = False  # guarded_by: self._cond
         self._m_requests = _metrics.counter(
             "serve_requests_total", "serving requests by outcome")
         self._m_rejects = _metrics.counter(
@@ -193,7 +193,7 @@ class MicroBatcher:
         # cheap pre-check BEFORE planning: under overload the fast-reject
         # must not pay plan_request's pad/cast array copies per bounced
         # request (the authoritative check re-runs under the lock below)
-        if self._pending >= self._max_queue:
+        if self._pending >= self._max_queue:  # race_lint: ignore[unguarded-read] — benign racy fast-path; authoritative re-check under the lock below
             self._reject_span(ctx, ts_wall, t_sub, "queue_full")
             self._reject_full()
         ver = self._registry.get(self._name)
@@ -229,7 +229,7 @@ class MicroBatcher:
         self._m_rejects.inc(model=self._name, reason="queue_full")
         self._m_requests.inc(model=self._name, outcome="queue_full")
         raise QueueFullError(
-            f"model {self._name!r}: {self._pending} requests "
+            f"model {self._name!r}: {self._pending} requests "  # race_lint: ignore[unguarded-read] — depth in the error text may be stale by one tick; harmless
             f"already queued (max_queue={self._max_queue}) — "
             f"retry with backoff")
 

@@ -1,0 +1,630 @@
+"""Concurrency lint (paddle_tpu.analysis.concurrency + tools/race_lint.py).
+
+Two halves:
+
+* A fixture corpus — for every diagnostic code at least one seeded-racy
+  positive (the analyzer MUST fire) and one disciplined negative (it
+  MUST stay silent), plus the guard-inference and suppression
+  machinery.
+* The repo gate — the analyzer sweeps `paddle_tpu/` itself and fails on
+  any WARNING/ERROR finding absent from the reviewed baseline
+  (tools/race_lint_baseline.json). Stale baseline entries are reported
+  but do not fail: deleting dead residue must never break CI.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis import Severity
+from paddle_tpu.analysis.concurrency import (analyze_package,
+                                             analyze_source, baseline_key)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(src):
+    return analyze_source(textwrap.dedent(src), "fixture.py")
+
+
+def codes(diags, gating_only=False):
+    return [d.code for d in diags
+            if not gating_only or d.severity >= Severity.WARNING]
+
+
+# ---------------------------------------------------------------------------
+# unguarded-write / unguarded-read (annotated discipline)
+# ---------------------------------------------------------------------------
+
+RACY_COUNTER = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._mu = threading.Lock()
+            self._n = 0  # guarded_by: self._mu
+
+        def start(self):
+            threading.Thread(target=self._worker).start()
+
+        def _worker(self):
+            with self._mu:
+                self._n += 1
+
+        def bump(self):
+            self._n += 1        # seeded race: no lock
+
+        def peek(self):
+            return self._n      # seeded race: no lock
+"""
+
+
+def test_unguarded_write_fires():
+    got = codes(run(RACY_COUNTER))
+    assert "unguarded-write" in got
+    assert "unguarded-read" in got
+
+
+def test_annotated_unguarded_write_is_error():
+    sevs = {d.code: d.severity for d in run(RACY_COUNTER)}
+    assert sevs["unguarded-write"] == Severity.ERROR
+
+
+def test_disciplined_counter_is_clean():
+    clean = RACY_COUNTER.replace(
+        """
+        def bump(self):
+            self._n += 1        # seeded race: no lock
+
+        def peek(self):
+            return self._n      # seeded race: no lock
+""",
+        """
+        def bump(self):
+            with self._mu:
+                self._n += 1
+
+        def peek(self):
+            with self._mu:
+                return self._n
+""")
+    assert codes(run(clean), gating_only=True) == []
+
+
+def test_init_writes_are_pre_publication():
+    # the seeded-racy fixture never flags the __init__ assignment itself
+    diags = run(RACY_COUNTER)
+    assert all(d.line != 7 for d in diags)
+
+
+def test_entry_held_through_private_helper():
+    # a private helper whose every call site holds the lock inherits it
+    src = """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._v = 0  # guarded_by: self._mu
+
+            def start(self):
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                with self._mu:
+                    self._bump()
+
+            def put(self):
+                with self._mu:
+                    self._bump()
+
+            def _bump(self):
+                self._v += 1
+    """
+    assert codes(run(src), gating_only=True) == []
+
+
+# ---------------------------------------------------------------------------
+# guard-mismatch
+# ---------------------------------------------------------------------------
+
+def test_guard_mismatch_fires():
+    src = """
+        import threading
+
+        class Two:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._v = 0  # guarded_by: self._a
+
+            def start(self):
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                with self._a:
+                    self._v += 1
+
+            def wrong(self):
+                with self._b:
+                    self._v += 1   # holds _b, annotated _a
+    """
+    assert "guard-mismatch" in codes(run(src))
+
+
+def test_right_lock_no_mismatch():
+    src = """
+        import threading
+
+        class Two:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._v = 0  # guarded_by: self._a
+
+            def start(self):
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                with self._a:
+                    self._v += 1
+
+            def right(self):
+                with self._b:
+                    with self._a:
+                        self._v += 1
+    """
+    assert codes(run(src), gating_only=True) == []
+
+
+# ---------------------------------------------------------------------------
+# lock-order-cycle
+# ---------------------------------------------------------------------------
+
+def test_lock_order_cycle_fires():
+    src = """
+        import threading
+
+        class AB:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def fwd(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def rev(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """
+    diags = run(src)
+    assert "lock-order-cycle" in codes(diags)
+    sevs = [d.severity for d in diags if d.code == "lock-order-cycle"]
+    assert all(s == Severity.ERROR for s in sevs)
+
+
+def test_consistent_order_is_clean():
+    src = """
+        import threading
+
+        class AB:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """
+    assert codes(run(src), gating_only=True) == []
+
+
+def test_self_deadlock_on_plain_lock():
+    # re-acquiring a non-reentrant Lock through a helper deadlocks
+    src = """
+        import threading
+
+        class Re:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def outer(self):
+                with self._mu:
+                    self._inner()
+
+            def _inner(self):
+                with self._mu:
+                    pass
+    """
+    assert "lock-order-cycle" in codes(run(src))
+
+
+def test_rlock_reentry_is_clean():
+    src = """
+        import threading
+
+        class Re:
+            def __init__(self):
+                self._mu = threading.RLock()
+
+            def outer(self):
+                with self._mu:
+                    self._inner()
+
+            def _inner(self):
+                with self._mu:
+                    pass
+    """
+    assert codes(run(src), gating_only=True) == []
+
+
+def test_cross_class_cycle():
+    # A holds its lock and calls into B; B holds its lock and calls
+    # back into A — a cycle only visible across class boundaries. The
+    # analyzer types attributes from ctor calls in __init__, so the
+    # fixture wires both directions that way (never executed).
+    src = """
+        import threading
+
+        class Peer:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._owner = Owner()
+
+            def poke(self):
+                with self._mu:
+                    self._owner.kick()
+
+        class Owner:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._peer = Peer()
+
+            def kick(self):
+                with self._mu:
+                    pass
+
+            def poke(self):
+                with self._mu:
+                    self._peer.poke()
+    """
+    assert "lock-order-cycle" in codes(run(src))
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------------
+
+def test_sleep_under_lock_fires():
+    src = """
+        import threading
+        import time
+
+        class Napper:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def nap(self):
+                with self._mu:
+                    time.sleep(1.0)
+    """
+    assert "blocking-under-lock" in codes(run(src))
+
+
+def test_sleep_outside_lock_is_clean():
+    src = """
+        import threading
+        import time
+
+        class Napper:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def nap(self):
+                with self._mu:
+                    x = 1
+                time.sleep(1.0)
+    """
+    assert codes(run(src), gating_only=True) == []
+
+
+def test_blocking_propagates_through_helpers():
+    # the blocking call is two frames down; the lock is at the top
+    src = """
+        import threading
+        import time
+
+        class Deep:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def top(self):
+                with self._mu:
+                    self._mid()
+
+            def _mid(self):
+                self._leaf()
+
+            def _leaf(self):
+                time.sleep(0.5)
+    """
+    assert "blocking-under-lock" in codes(run(src))
+
+
+def test_condition_wait_releases_own_mutex():
+    # Condition.wait drops the condition's OWN lock — no hazard
+    src = """
+        import threading
+
+        class Waiter:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._ready = False  # guarded_by: self._cond
+
+            def start(self):
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                with self._cond:
+                    self._ready = True
+                    self._cond.notify_all()
+
+            def wait(self):
+                with self._cond:
+                    while not self._ready:
+                        self._cond.wait(0.1)
+    """
+    assert codes(run(src), gating_only=True) == []
+
+
+def test_condition_wait_with_second_lock_held_fires():
+    src = """
+        import threading
+
+        class Waiter:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._cond = threading.Condition()
+
+            def wait(self):
+                with self._mu:
+                    with self._cond:
+                        self._cond.wait()
+    """
+    assert "blocking-under-lock" in codes(run(src))
+
+
+# ---------------------------------------------------------------------------
+# guard-inference
+# ---------------------------------------------------------------------------
+
+INFER_SRC = """
+    import threading
+
+    class Mostly:
+        def __init__(self):
+            self._mu = threading.Lock()
+            self._v = 0
+
+        def start(self):
+            threading.Thread(target=self._loop).start()
+
+        def _loop(self):
+            with self._mu:
+                self._v += 1
+
+        def a(self):
+            with self._mu:
+                self._v += 1
+
+        def b(self):
+            with self._mu:
+                return self._v
+
+        def outlier(self):
+            return self._v     # 3/4 sites lock — this one is suspect
+"""
+
+
+def test_inference_proposes_and_flags_outlier():
+    diags = run(INFER_SRC)
+    infos = [d for d in diags if d.code == "guard-inference"]
+    assert infos and "self._mu" in infos[0].message
+    assert "unguarded-read" in codes(diags, gating_only=True)
+
+
+def test_inferred_outlier_is_warning_not_error():
+    src = INFER_SRC.replace("return self._v     #", "self._v = 9      #")
+    sevs = [d.severity for d in run(src) if d.code == "unguarded-write"]
+    assert sevs and all(s == Severity.WARNING for s in sevs)
+
+
+def test_below_ratio_no_inference():
+    # one locked += (an AugAssign counts as read+write) vs one unlocked
+    # read: 2/3 accesses hold the lock — 0.67 < 0.70, too weak
+    src = INFER_SRC.replace(
+        """
+        def a(self):
+            with self._mu:
+                self._v += 1
+
+        def b(self):
+            with self._mu:
+                return self._v
+""", "")
+    diags = run(src)
+    assert "guard-inference" not in codes(diags)
+    assert codes(diags, gating_only=True) == []
+
+
+def test_single_thread_class_not_flagged():
+    # no spawned thread -> fields are not cross-thread -> silence
+    src = """
+        import threading
+
+        class Solo:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._v = 0
+
+            def a(self):
+                with self._mu:
+                    self._v += 1
+
+            def b(self):
+                self._v += 1
+
+            def c(self):
+                with self._mu:
+                    self._v += 1
+
+            def d(self):
+                with self._mu:
+                    self._v += 1
+    """
+    assert codes(run(src), gating_only=True) == []
+
+
+# ---------------------------------------------------------------------------
+# suppression
+# ---------------------------------------------------------------------------
+
+def test_inline_suppression():
+    src = RACY_COUNTER.replace(
+        "self._n += 1        # seeded race: no lock",
+        "self._n += 1  # race_lint: ignore[unguarded-write] — test")
+    got = codes(run(src), gating_only=True)
+    assert "unguarded-write" not in got
+    assert "unguarded-read" in got   # the peek() race still fires
+
+
+def test_bare_suppression_covers_all_codes():
+    # a bare ignore on the += line kills BOTH halves of the AugAssign
+    # (its read and its write); peek()'s independent race still fires
+    src = RACY_COUNTER.replace(
+        "self._n += 1        # seeded race: no lock",
+        "self._n += 1  # race_lint: ignore")
+    got = codes(run(src), gating_only=True)
+    assert "unguarded-write" not in got
+    assert "unguarded-read" in got
+
+
+def test_skip_file():
+    src = "# race_lint: skip-file\n" + textwrap.dedent(RACY_COUNTER)
+    assert analyze_source(src, "fixture.py") == []
+
+
+# ---------------------------------------------------------------------------
+# diagnostics plumbing
+# ---------------------------------------------------------------------------
+
+def test_baseline_key_is_line_free():
+    d1 = run(RACY_COUNTER)
+    d2 = analyze_source(
+        "\n\n\n" + textwrap.dedent(RACY_COUNTER), "fixture.py")
+    k1 = sorted(baseline_key(d) for d in d1 if d.severity >= Severity.WARNING)
+    k2 = sorted(baseline_key(d) for d in d2 if d.severity >= Severity.WARNING)
+    assert k1 == k2
+
+
+def test_diagnostic_fields():
+    d = next(d for d in run(RACY_COUNTER) if d.code == "unguarded-write")
+    assert d.path == "fixture.py"
+    assert d.qual.startswith("Counter.")
+    assert d.line > 0
+    assert "Counter._n" in d.message
+
+
+# ---------------------------------------------------------------------------
+# the repo gate (tier-1): paddle_tpu/ itself vs the reviewed baseline
+# ---------------------------------------------------------------------------
+
+def _load_baseline():
+    with open(os.path.join(REPO, "tools", "race_lint_baseline.json")) as f:
+        doc = json.load(f)
+    return {e["key"]: e.get("note", "") for e in doc["entries"]}
+
+
+def test_repo_is_race_lint_clean():
+    """Every WARNING/ERROR the analyzer finds in paddle_tpu/ must be a
+    reviewed baseline entry. New findings fail here — fix the race,
+    suppress with a reasoned `# race_lint: ignore[...]`, or triage it
+    into tools/race_lint_baseline.json with a real note."""
+    baseline = _load_baseline()
+    diags = analyze_package(os.path.join(REPO, "paddle_tpu"), root=REPO)
+    gating = [d for d in diags if d.severity >= Severity.WARNING]
+    new = [d for d in gating if baseline_key(d) not in baseline]
+    assert not new, (
+        "new concurrency findings (see docs/ANALYSIS.md, Concurrency "
+        "lint):\n" + "\n".join(d.format() for d in new))
+
+
+def test_baseline_entries_have_triage_notes():
+    for key, note in _load_baseline().items():
+        assert note and "TODO" not in note, (
+            f"baseline entry {key!r} lacks a reviewed triage note")
+
+
+def test_stale_baseline_entries_do_not_fail():
+    # the gate tolerates residue that has since been fixed: stale keys
+    # are a cleanup chore, not a CI failure
+    diags = analyze_package(os.path.join(REPO, "paddle_tpu"), root=REPO)
+    live = {baseline_key(d) for d in diags
+            if d.severity >= Severity.WARNING}
+    assert live <= set(_load_baseline())
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _cli(*args, cwd=None):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "race_lint.py"),
+         *args],
+        capture_output=True, text=True, cwd=cwd or REPO, timeout=120)
+
+
+@pytest.mark.slow
+def test_cli_repo_passes_against_baseline():
+    r = _cli("paddle_tpu/")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+@pytest.mark.slow
+def test_cli_json_format_and_exit_codes(tmp_path):
+    bad = tmp_path / "racy.py"
+    bad.write_text(textwrap.dedent(RACY_COUNTER))
+    r = _cli("--no-baseline", "--format", "json", str(bad))
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    assert any(e["code"] == "unguarded-write" for e in doc["diagnostics"])
+
+    r2 = _cli("--nonsense-flag")
+    assert r2.returncode == 2
+
+
+@pytest.mark.slow
+def test_cli_update_baseline_roundtrip(tmp_path):
+    bad = tmp_path / "racy.py"
+    bad.write_text(textwrap.dedent(RACY_COUNTER))
+    bl = tmp_path / "bl.json"
+    r = _cli("--baseline", str(bl), "--update-baseline", str(bad))
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(bl.read_text())
+    assert doc["entries"]
+    r2 = _cli("--baseline", str(bl), str(bad))
+    assert r2.returncode == 0, r2.stdout + r2.stderr
