@@ -320,6 +320,7 @@ BENCH_SEGMENTS = (
     "haven_subprocess",
     "quorum_subprocess",
     "elastic_subprocess",
+    "horizon_subprocess",
     "transformer256_remeasure",
     "resnet50_remeasure",
     "planner_subprocess",
@@ -434,6 +435,53 @@ def serve_loadgen_subprocess():
     if rc != 0:
         rec["serve_loadgen_rc"] = rc
     return rec
+
+
+def horizon_subprocess():
+    """fluid-horizon trace-context overhead: ONE oneshot serve loadgen
+    with observe ON throughout, alternating the `trace` flag off (no
+    span ids, no recording, legacy wire frames) and on across paired
+    open-loop phases. Both halves pay for the metrics/pulse plane, so
+    the delta prices trace context ALONE. Acceptance: median paired
+    open-loop p50 delta within 2% of the trace-off p50.
+
+    PAIRED IN ONE PROCESS (`--trace-ab`): two separate loadgen
+    subprocesses differ by tens of microseconds from allocator layout
+    and CPU frequency alone — more than the tracing effect under test —
+    so the loadgen alternates the flag across open-loop phases of ONE
+    warmed process and the gate reads the median paired p50 delta.
+    Phases are grouped into ABBA blocks (off,on,on,off — mirrored every
+    other block): the latency floor also wanders WITHIN a run by more
+    than the effect, and a fixed phase order turns that drift into
+    systematic bias, while ABBA cancels linear drift inside each block.
+
+    Single in-process client (`--threads 1`): the loadgen's default 4
+    in-process client threads all contend for this 1-core container's
+    GIL, and that client-side contention amplifies any server-side work
+    severalfold — a rig artifact (real serving clients are remote
+    processes; their scheduling doesn't tax the server's interpreter).
+    One client still exercises the full submit -> batch -> record path,
+    so the delta prices the server-side trace cost the gate is about."""
+    res, rc = _tool_json(
+        "serve_loadgen.py", "horizon trace A/B (paired)",
+        args=("--trace-ab", "8", "--duration", "64", "--threads", "1",
+              "--no-swap"))
+    if res is None:
+        return {"horizon_trace_overhead_pct": -1.0,
+                "horizon_overhead_ok": False}
+    p50_off = res.get("serve_p50_us_trace_off", 0.0)
+    p50_on = res.get("serve_p50_us_trace_on", 0.0)
+    delta = res.get("trace_p50_delta_us", 0.0)
+    overhead = res.get("trace_overhead_pct", -1.0)
+    return {
+        "horizon_trace_overhead_pct": overhead,
+        "horizon_overhead_ok": bool(0 <= overhead <= 2.0 or delta <= 0),
+        "horizon_p50_us_trace_off": p50_off,
+        "horizon_p50_us_trace_on": p50_on,
+        "horizon_p50_delta_us": delta,
+        "horizon_ab_rounds": res.get("trace_ab_rounds", 0),
+        "horizon_ab_rc": rc,
+    }
 
 
 def decode_loadgen_subprocess():
@@ -738,8 +786,8 @@ def _emit_partial_and_exit(reason=None):
             from paddle_tpu.observe import flight as _flight
             _flight.set_stage(str(_PARTIAL["extra"].get("failure_stage")))
             fp = _flight.dump(
-                os.environ.get("BENCH_FLIGHT_PATH",
-                               "flight_recorder.json"),
+                os.environ.get("BENCH_FLIGHT_PATH")
+                or _flight.default_dump_path(),
                 reason=str(_PARTIAL["extra"]["bench_failure"])[:200])
             if fp:
                 _PARTIAL["extra"]["flight_recorder"] = fp
@@ -1119,6 +1167,10 @@ def main(argv=None):
     # the scale-up admission latency of a new trainer joining mid-job
     elasticrec = seg("elastic_subprocess", elastic_subprocess, {})
     note(**elasticrec)
+    # fluid-horizon: trace-context overhead gate — serve loadgen A/B
+    # with the observe plane off vs on (acceptance: p50 within 2%)
+    horizonrec = seg("horizon_subprocess", horizon_subprocess, {})
+    note(**horizonrec)
     # the headline pair is drift-sensitive through the dev tunnel, and
     # the noise is ONE-SIDED: a stall can only lower a reading below the
     # true device rate, never raise it (the device cannot run faster
@@ -1261,11 +1313,12 @@ def main(argv=None):
     # telemetry accumulated in _PARTIAL plus the whole-run compile story.
     extra["failure_stage"] = (_PARTIAL["extra"].get("failed_stages")
                               or [None])[0]
-    for k in ("failed_stages", "skipped_segments", "segment_wall_s",
-              "step_phases_us", "recompiles", "mem_peak_est_bytes",
-              "mem_live_bytes", "pulse_port"):
-        if k in _PARTIAL["extra"]:
-            extra[k] = _PARTIAL["extra"][k]
+    # every note()'d key rides along — segment records whose metrics are
+    # NOT mirrored in the literal above (fleet/quorum/elastic/horizon/
+    # decode) used to be silently dropped on a SUCCESSFUL run and only
+    # survived in watchdog partials; explicit entries keep precedence
+    for k, v in _PARTIAL["extra"].items():
+        extra.setdefault(k, v)
     extra["recompile_causes_total"] = _recompile_counts()
     drift = check_claims(extra)
     if drift:

@@ -36,6 +36,13 @@ _DEFS: Dict[str, tuple] = {
     # keeps the prepared fast path free of registry writes; compile-time
     # recompile events are recorded regardless (they are never hot)
     "observe": (False, bool),
+    # the distributed-tracing half of the observe plane (observe/xray):
+    # span ids, span recording, and the traceparent element on outbound
+    # RPC frames. Only consulted while "observe" is on; turning it off
+    # leaves metrics/pulse armed but makes every wire frame legacy-shaped
+    # and every span a no-op — bench.py's horizon segment A/Bs exactly
+    # this bit to price trace context on the serve path
+    "trace": (True, bool),
 }
 
 _FLAGS: Dict[str, Any] = {}

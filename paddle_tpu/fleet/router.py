@@ -43,9 +43,11 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
+from .. import flags as _flags
 from ..ark.liveness import LeaseTable, QuorumLeaseTable
 from ..ark.retry import RetryPolicy
 from ..observe import metrics as _metrics
+from ..observe import xray as _xray
 from ..pserver import rpc as _rpc
 from ..serve.errors import (DeadlineExceededError, ModelUnavailableError,
                             ServeError)
@@ -434,7 +436,21 @@ class FleetRouter(_wire.HardCutServer):
             return tied[self._rr % len(tied)]
 
     def _request(self, model: str, cmd: str, payload: dict) -> FleetResult:
-        """The routed request core: gate, pick, call, classify, retry."""
+        """The routed request core: gate, pick, call, classify, retry.
+
+        fluid-horizon entry point: with the observe flag on, the whole
+        routed request runs under a `fleet:{cmd}` span — the trace ROOT
+        when no caller context is ambient — so every wire.call to a
+        replica (and everything the replica fans out to: batcher,
+        sparse PSClient, pserver) parents under one trace."""
+        if _flags.get_flag("observe"):
+            with _xray.span(f"fleet:{cmd}", cat="fleet", model=model,
+                            cmd=cmd):
+                return self._request_inner(model, cmd, payload)
+        return self._request_inner(model, cmd, payload)
+
+    def _request_inner(self, model: str, cmd: str,
+                       payload: dict) -> FleetResult:
         payload = {"model": model, **payload}
         gate_deadline = time.monotonic() + \
             self.config.swap_drain_timeout_s + 5.0

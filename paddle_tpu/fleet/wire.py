@@ -27,6 +27,7 @@ from __future__ import annotations
 import socket as _socket
 import struct as _struct
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from .. import flags as _flags
@@ -200,20 +201,23 @@ def call(pool: ConnPool, cmd: str, payload: Optional[dict] = None,
     for ("err", ...), and lets transport errors propagate (the socket is
     discarded either way on failure).
 
-    fluid-xray: with the observe flag on, the frame carries the ambient
-    traceparent as the optional third element — the replica handler's
-    span joins the router-side request trace, exactly like the pserver
-    frames."""
+    fluid-xray: with the observe flag on, the frame carries a fresh
+    child of the ambient traceparent as the optional third element, and
+    the call records that child as a `fleet_call:<cmd>` span — the
+    replica handler's `replica:<cmd>` span parents under it, so the
+    stitched fleet timeline has no orphaned hop (exactly the pserver
+    client's per-attempt `rpc_client` shape)."""
     sock = pool.checkout()
     broken = True
+    ctx = _xray.child_of() if _flags.get_flag("observe") else None
+    ts_wall, t0 = time.time(), time.perf_counter()
+    status = "transport_error"
     try:
         if deadline_s is not None:
             sock.settimeout(deadline_s)
         frame = (cmd, payload or {})
-        if _flags.get_flag("observe"):
-            ctx = _xray.child_of()
-            if ctx is not None:
-                frame = (cmd, payload or {}, _xray.to_wire(ctx))
+        if ctx is not None:
+            frame = (cmd, payload or {}, _xray.to_wire(ctx))
         _rpc.send_msg(sock, frame)
         status, value = _rpc.recv_msg(sock)
         if deadline_s is not None:
@@ -225,4 +229,9 @@ def call(pool: ConnPool, cmd: str, payload: Optional[dict] = None,
             raise_serve_error(value)
         raise RuntimeError(f"fleet peer {pool.endpoint} {cmd}: {value}")
     finally:
+        if ctx is not None:
+            _xray.record_span(
+                f"fleet_call:{cmd}", ctx, ts_wall,
+                time.perf_counter() - t0, cat="fleet", cmd=cmd,
+                endpoint=pool.endpoint, status=status)
         pool.checkin(sock, broken=broken)

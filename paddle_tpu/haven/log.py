@@ -36,8 +36,10 @@ class UpdateLog:
         self.window = int(window)
         self.stall_timeout_s = float(stall_timeout_s)
         self._cond = threading.Condition()
-        # list of (seq, cmd, payload, t_monotonic); seqs are contiguous
-        self._records: List[Tuple[int, str, dict, float]] = []  # guarded_by: self._cond
+        # list of (seq, cmd, payload, t_monotonic, trace); seqs are
+        # contiguous; trace is the recording request's traceparent (or
+        # None) — fluid-horizon links the backup's apply span to it
+        self._records: List[Tuple[int, str, dict, float, Optional[str]]] = []  # guarded_by: self._cond
         self._head = 0      # guarded_by: self._cond
         self._acked = 0     # guarded_by: self._cond
         self._degraded = False  # guarded_by: self._cond
@@ -45,11 +47,14 @@ class UpdateLog:
         self._needs_resync = True  # guarded_by: self._cond
 
     # -- primary write path ----------------------------------------------
-    def append(self, cmd: str, payload: dict) -> Optional[int]:
+    def append(self, cmd: str, payload: dict,
+               trace: Optional[str] = None) -> Optional[int]:
         """Record one applied update; returns its seq, or None when the
         log is degraded (the update is applied locally but will only
         reach the backup via the next full resync). Blocks while the
-        in-flight window is full — this backpressure IS the loss bound."""
+        in-flight window is full — this backpressure IS the loss bound.
+        `trace` (a traceparent string) names the request that caused
+        the update, so the backup's replay parents under it."""
         deadline = time.monotonic() + self.stall_timeout_s
         with self._cond:
             if self._degraded:
@@ -66,19 +71,21 @@ class UpdateLog:
                     return None
             self._head += 1
             self._records.append((self._head, cmd, payload,
-                                  time.monotonic()))
+                                  time.monotonic(), trace))
             self._cond.notify_all()
             return self._head
 
     # -- forwarder read path ---------------------------------------------
     def batch(self, max_records: int = 64
-              ) -> List[Tuple[int, str, dict]]:
+              ) -> List[Tuple[int, str, dict, Optional[str]]]:
         """Unacked records in seq order (oldest first), up to
-        `max_records`. Retransmits everything past the watermark — the
-        backup dedups by seq, so a lost ack costs bytes, never
+        `max_records`, as (seq, cmd, payload, trace) — the backup's
+        replay accepts the legacy 3-tuple shape too, so a mixed-version
+        pair keeps streaming. Retransmits everything past the watermark
+        — the backup dedups by seq, so a lost ack costs bytes, never
         correctness."""
         with self._cond:
-            return [(s, c, p) for s, c, p, _t in
+            return [(s, c, p, tr) for s, c, p, _t, tr in
                     self._records[:max_records]]
 
     def ack(self, seq: int) -> None:

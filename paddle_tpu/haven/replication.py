@@ -79,6 +79,7 @@ from .. import flags as _flags
 from ..ark.liveness import LeaseTable
 from ..observe import flight as _flight
 from ..observe import metrics as _metrics
+from ..observe import xray as _xray
 from .log import UpdateLog
 
 logger = logging.getLogger(__name__)
@@ -277,8 +278,17 @@ class HavenState:
         rep = self._replicator
         if self.role != "primary" or rep is None:
             return
+        trace = None
+        if _flags.get_flag("observe"):
+            # fluid-horizon: remember WHICH request produced this update
+            # (the rpc_server:* span active in the dispatching handler),
+            # so the backup's replay span joins the trainer's trace
+            # across the replication stream
+            ctx = _xray.current()
+            if ctx is not None:
+                trace = _xray.to_traceparent(ctx)
         was = self.log.degraded
-        if self.log.append(cmd, payload) is None and not was:
+        if self.log.append(cmd, payload, trace=trace) is None and not was:
             _flight.note("haven_degraded", endpoint=self.server.endpoint,
                          head_seq=self.log.head_seq)
             logger.warning("haven %s: replication degraded (backup %s "
@@ -450,16 +460,29 @@ class HavenState:
             return ("ok", {"acked": self.applied_seq, "epoch": self.epoch,
                            "need_resync": True})
         need_resync = False
+        obs = _flags.get_flag("observe")
         with self._replay_lock, self.mutator():
             # mutator(): a backup-side save/snapshot quiesce must not
             # observe a half-replayed record
-            for seq, cmd, payload in records:
+            for seq, cmd, payload, *rest in records:
                 if seq <= self.applied_seq:
                     continue
                 if seq != self.applied_seq + 1:
                     need_resync = True
                     break
-                self._apply_record(cmd, payload)
+                # fluid-horizon: a 4-tuple record carries the causing
+                # request's traceparent — the apply span closes the
+                # trainer -> primary -> backup chain (3-tuples from a
+                # legacy primary replay untraced)
+                rctx = _xray.parse_traceparent(rest[0]) \
+                    if obs and rest else None
+                if rctx is not None:
+                    with _xray.activate(rctx), \
+                            _xray.span(f"haven_apply:{cmd}", cat="ha",
+                                       seq=seq, cmd=cmd):
+                        self._apply_record(cmd, payload)
+                else:
+                    self._apply_record(cmd, payload)
                 self.applied_seq = seq
         reply = {"acked": self.applied_seq, "epoch": self.epoch}
         if need_resync or not self.has_synced:

@@ -37,6 +37,7 @@ from .. import flags as _flags
 from ..ark.retry import RetryPolicy
 from ..observe import flight as _flight
 from ..observe import metrics as _metrics
+from ..observe import xray as _xray
 from ..pserver import rpc
 
 
@@ -81,7 +82,16 @@ class MasterClient:
         deadline_at = None if deadline is None \
             else time.monotonic() + deadline
         attempt = 0
+        obs = _flags.get_flag("observe")
         while True:
+            # fluid-horizon: one span context PER ATTEMPT (child of the
+            # ambient trainer-step/caller span when one is active), sent
+            # as the frame's optional third element so the master
+            # handler's span parents here — retries are then distinct
+            # child spans, not one blurred edge.
+            att_ctx = _xray.child_of() if obs else None
+            att_ts = time.time() if obs else 0.0
+            att_t0 = time.perf_counter() if obs else 0.0
             try:
                 # The lock covers exactly one request/response exchange:
                 # the send/recv pair must be atomic on the shared socket,
@@ -96,13 +106,25 @@ class MasterClient:
                     if deadline_at is not None:
                         self._sock.settimeout(
                             max(0.05, deadline_at - time.monotonic()))
-                    rpc.send_msg(self._sock, (cmd, payload))  # race_lint: ignore[blocking-under-lock] — request/response pair must be atomic on the shared socket
+                    frame = (cmd, payload) if att_ctx is None else \
+                        (cmd, payload, _xray.to_wire(att_ctx))
+                    rpc.send_msg(self._sock, frame)  # race_lint: ignore[blocking-under-lock] — request/response pair must be atomic on the shared socket
                     status, value = rpc.recv_msg(self._sock)  # race_lint: ignore[blocking-under-lock] — request/response pair must be atomic on the shared socket
                     if deadline_at is not None:
                         self._sock.settimeout(None)
+                    if att_ctx is not None:
+                        _xray.record_span(
+                            f"master_client:{cmd}", att_ctx, att_ts,
+                            time.perf_counter() - att_t0, cat="rpc",
+                            cmd=cmd, endpoint=ep, status=status)
                     return status, value
             except (ConnectionError, EOFError, OSError,
-                    _socket.timeout):
+                    _socket.timeout) as e:
+                if att_ctx is not None:
+                    _xray.record_span(
+                        f"master_client:{cmd}", att_ctx, att_ts,
+                        time.perf_counter() - att_t0, cat="rpc",
+                        cmd=cmd, endpoint=ep, error=type(e).__name__)
                 with self._lock:
                     self._close_sock_locked()
                 out_of_time = deadline_at is not None and \

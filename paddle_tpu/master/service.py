@@ -63,6 +63,7 @@ from .. import flags as _flags
 from ..ark import checkpoint as ark_ckpt
 from ..observe import flight as _flight
 from ..observe import metrics as _metrics
+from ..observe import xray as _xray
 from ..pserver import rpc
 
 logger = logging.getLogger(__name__)
@@ -562,9 +563,17 @@ class Master:
             # master) has nobody to feed
             return
         self._ha_seq += 1
-        self._ha_log.append((self._ha_seq,
-                             {"task": t.to_dict(), "queue": queue,
-                              "pass": self._epoch_pass}))
+        rec = {"task": t.to_dict(), "queue": queue,
+               "pass": self._epoch_pass}
+        if _flags.get_flag("observe"):
+            # fluid-horizon: the record remembers WHICH request caused it
+            # (the master_server:* span active in this dispatch), so the
+            # standby's apply span joins the trainer's trace across the
+            # replication stream
+            ctx = _xray.current()
+            if ctx is not None:
+                rec["trace"] = _xray.to_traceparent(ctx)
+        self._ha_log.append((self._ha_seq, rec))
         if len(self._ha_log) > self._ha_log_cap:
             del self._ha_log[: len(self._ha_log) - self._ha_log_cap]
         self._ha_dirty.set()
@@ -669,8 +678,25 @@ class Master:
                                timeout=self.lease_s)
             self._standby_sock = sock
         sock.settimeout(self.lease_s)
-        rpc.send_msg(sock, ("m_replicate", payload))
+        frame = ("m_replicate", payload)
+        fctx = None
+        if _flags.get_flag("observe"):
+            # forwarder thread has no ambient context: each batch is a
+            # fresh root span whose id rides the frame, so the standby's
+            # master_server:m_replicate span parents here
+            fctx = _xray.child_of()
+            if fctx is not None:
+                frame = ("m_replicate", payload, _xray.to_wire(fctx))
+        fts = time.time()
+        ft0 = time.monotonic()
+        rpc.send_msg(sock, frame)
         status, value = rpc.recv_msg(sock)
+        if fctx is not None:
+            _xray.record_span("master_fwd:m_replicate", fctx, fts,
+                              time.monotonic() - ft0, cat="ha",
+                              records=len(payload["records"]),
+                              snapshot="snapshot" in payload,
+                              status=status)
         sock.settimeout(None)
         if status == "redirect":
             # the standby answers for a RULER at a higher epoch: this
@@ -794,6 +820,7 @@ class Master:
             if snapshot is not None:
                 self._install_state_locked(snapshot)
                 self._applied_seq = int(base_seq)
+            obs = _flags.get_flag("observe")
             for seq, rec in records:
                 seq = int(seq)
                 if seq <= self._applied_seq:
@@ -801,7 +828,19 @@ class Master:
                 if seq > self._applied_seq + 1:
                     return ("ok", {"need_sync": True,
                                    "applied_seq": self._applied_seq})
-                self._apply_record_locked(rec)
+                # fluid-horizon: the record carries the traceparent of
+                # the request that produced it — the standby's apply
+                # span closes the trainer -> primary -> standby chain
+                rctx = _xray.parse_traceparent(rec.get("trace")) \
+                    if obs else None
+                if rctx is not None:
+                    with _xray.activate(rctx), \
+                            _xray.span("master_apply:"
+                                       + str(rec.get("queue")),
+                                       cat="ha", seq=seq):
+                        self._apply_record_locked(rec)
+                else:
+                    self._apply_record_locked(rec)
                 self._applied_seq = seq
             self._snapshot_locked()
             return ("ok", {"applied_seq": self._applied_seq})
@@ -1035,13 +1074,37 @@ class Master:
         try:
             while not self._stop.is_set():
                 try:
-                    cmd, p = rpc.recv_msg(conn)
+                    msg = rpc.recv_msg(conn)
                 except (ConnectionError, EOFError, OSError):
                     return
                 if self._stop.is_set():
                     return   # dead process: drop the request unanswered
                 try:
-                    reply = self._dispatch(cmd, p)
+                    # (cmd, payload[, meta]): the optional meta dict
+                    # carries the caller's traceparent (fluid-horizon) —
+                    # legacy 2-tuple frames keep working
+                    cmd, p = msg[0], msg[1]
+                    meta = msg[2] if len(msg) >= 3 else None
+                except (TypeError, IndexError):
+                    try:
+                        rpc.send_msg(conn, ("err", "MalformedFrame: "
+                                            "expected (cmd, payload[, "
+                                            "meta])"))
+                        continue
+                    except (ConnectionError, OSError):
+                        return
+                wctx = _xray.from_wire(meta) \
+                    if meta and _flags.get_flag("observe") else None
+                try:
+                    if wctx is not None:
+                        with _xray.activate(wctx), \
+                                _xray.span(f"master_server:{cmd}",
+                                           cat="rpc", cmd=cmd,
+                                           endpoint=self.endpoint,
+                                           role=self.role):
+                            reply = self._dispatch(cmd, p)
+                    else:
+                        reply = self._dispatch(cmd, p)
                 except Exception as e:
                     reply = ("err", f"{type(e).__name__}: {e}")
                 try:

@@ -16,6 +16,10 @@ Three cooperating pieces (see docs/OBSERVABILITY.md):
   structured Alerts into the registry AND the flight ring (round 13)
 - `observe.memory`   — the HBM observatory: per-program peak estimates
   vs live device memory stats (round 13)
+- `observe.stitch`   — causal cross-process trace assembly: flow
+  events + clock-skew correction over merged chrome traces (round 21)
+- `observe.scrape`   — the fluid-horizon observatory: a scraper over
+  every pulse /metrics into one queryable time-series store (round 21)
 
 Emission from hot paths (Executor/PreparedProgram/ParallelExecutor steps,
 AsyncFeeder, pserver RPC) is gated on the `observe` flag:
@@ -32,13 +36,15 @@ from __future__ import annotations
 
 from .. import flags as _flags
 from . import flight, health, memory, metrics, pulse  # noqa: F401
-from . import steplog, tracer, xray  # noqa: F401
+from . import scrape, steplog, stitch, tracer, xray  # noqa: F401
 from .flight import get_flight  # noqa: F401
 from .health import get_engine  # noqa: F401
 from .metrics import counter, default_registry, gauge, histogram  # noqa: F401
 from .pulse import start_pulse, stop_pulse  # noqa: F401
+from .scrape import Scraper, TimeSeriesStore  # noqa: F401
 from .steplog import (StepStats, get_steplog, observatory,  # noqa: F401
                       preseed_shapes, track_shapes)
+from .stitch import stitch_traces, trace_tree  # noqa: F401
 from .tracer import get_tracer, merge_chrome_traces  # noqa: F401
 
 # fluid-pulse: every flight-recorder dump carries the memory observatory

@@ -38,6 +38,23 @@ from typing import Callable, List, Optional
 
 DEFAULT_CAPACITY = 512
 
+#: env override for where an UNINSTALLED recorder dumps (drills that
+#: never call install() used to litter `flight_recorder.json` into the
+#: CWD — i.e. the repo root when run from a checkout)
+DUMP_PATH_ENV = "PADDLE_TPU_FLIGHT_PATH"
+
+
+def default_dump_path() -> str:
+    """The dump path when neither dump(path=...) nor install(path=...)
+    named one: `$PADDLE_TPU_FLIGHT_PATH` if set, else a pid-suffixed
+    file under the system temp dir — NEVER the current directory."""
+    env = os.environ.get(DUMP_PATH_ENV)
+    if env:
+        return env
+    import tempfile
+    return os.path.join(tempfile.gettempdir(),
+                        f"flight_recorder.{os.getpid()}.json")
+
 
 def json_safe(v):
     """RFC 8259 has no NaN/Infinity but Python's json emits bare `NaN`
@@ -132,10 +149,12 @@ class FlightRecorder:
     def dump(self, path: Optional[str] = None,
              reason: Optional[str] = None) -> Optional[str]:
         """Write the black box as JSON. `path` defaults to the installed
-        path (install()) or `flight_recorder.json` in the cwd. Never
+        path (install()), then `$PADDLE_TPU_FLIGHT_PATH`, then a
+        pid-suffixed file under the system temp dir — never the CWD (a
+        drill run from a checkout must not litter the repo root). Never
         raises — a failing postmortem writer must not mask the original
         crash; returns the path written or None."""
-        path = path or self._dump_path or "flight_recorder.json"
+        path = path or self._dump_path or default_dump_path()
         try:
             snap = json_safe(self.snapshot(reason=reason))
             tmp = f"{path}.tmp.{os.getpid()}"

@@ -14,6 +14,9 @@ can expose one stdlib-HTTP thread serving its live telemetry:
                     the same shape tools/telemetry_dump.py prints, so
                     one tool reads dead and live processes
     GET /flight     the flight-recorder ring as JSON, live
+    GET /trace      the tracer ring as a chrome-trace document — what
+                    tools/observatory.py --dump-trace stitches across
+                    a live fleet (fluid-horizon)
 
 Opt-in and flag-gated:
 
@@ -109,11 +112,16 @@ class _PulseHandler(BaseHTTPRequestHandler):
             elif path == "/flight":
                 self._send_json(
                     200, _flight.get_flight().snapshot(reason="live"))
+            elif path == "/trace":
+                from . import tracer as _tracer
+                self._send_json(200, {
+                    "traceEvents": _tracer.get_tracer().chrome_events(),
+                    "displayTimeUnit": "ms"})
             elif path == "/":
                 self._send_json(200, {
                     "service": "fluid-pulse",
                     "endpoints": ["/metrics", "/healthz", "/readyz",
-                                  "/status", "/flight"]})
+                                  "/status", "/flight", "/trace"]})
             else:
                 self._send_json(404, {"error": f"no route {path!r}"})
         except Exception as e:   # a broken section must not kill the plane

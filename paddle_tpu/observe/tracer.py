@@ -126,12 +126,41 @@ class Tracer:
             self._events.append(ev)
         return ev
 
+    def record_ctx(self, name: str, ts: float, dur: float, cat: str,
+                   ctx, extra: dict):
+        """Hot-path append for xray spans: the ring stores a raw tuple
+        (no Span object, no trace-id formatting, no args-dict merge) and
+        `events()` materializes it into a Span on read. The serve path
+        records 2+ spans per request and exports ~never, so the horizon
+        A/B prices exactly this deferral. `ctx` is an immutable
+        SpanContext and `extra` is relinquished by the caller (stored,
+        not copied)."""
+        tid = threading.get_ident()
+        with self._lock:
+            if tid not in self._tid_names:
+                # conditional (unlike `record`'s refresh): serve/RPC hot
+                # threads are long-lived, and a recycled ident keeping a
+                # dead thread's track name is cosmetic — not worth a
+                # current_thread() lookup per request
+                self._tid_names[tid] = threading.current_thread().name
+            self._events.append((name, cat, ts, dur, tid, ctx, extra))
+
+    @staticmethod
+    def _materialize(ev) -> Span:
+        if ev.__class__ is Span:
+            return ev
+        name, cat, ts, dur, tid, ctx, extra = ev
+        args = ctx.trace_args()
+        if extra:
+            args.update(extra)
+        return Span(name, cat, ts, dur, tid, 0, args)
+
     def events(self, cat: Optional[str] = None) -> List[Span]:
         with self._lock:
             evs = list(self._events)
         if cat is not None:
-            evs = [e for e in evs if e.cat == cat]
-        return evs
+            return [e for e in map(self._materialize, evs) if e.cat == cat]
+        return [self._materialize(e) for e in evs]
 
     def clear(self):
         with self._lock:
@@ -206,18 +235,46 @@ def get_tracer() -> Tracer:
 
 # -- multi-process merge (fluid-xray) ---------------------------------------
 
+def load_chrome_trace(path: str) -> dict:
+    """Load one chrome-trace JSON file with a diagnosable failure mode:
+    an empty or non-JSON file raises ValueError NAMING the file (a
+    distributed drill merging N per-process dumps must say which worker
+    produced the bad one, not surface a bare JSONDecodeError), as does a
+    document without a `traceEvents` list."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        raise ValueError(f"unreadable trace file {path!r}: {e}") from e
+    if not text.strip():
+        raise ValueError(f"empty trace file {path!r} (the producing "
+                         "process likely died before export)")
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"malformed trace file {path!r}: {e}") from e
+    if not isinstance(doc, dict) or not isinstance(
+            doc.get("traceEvents"), list):
+        raise ValueError(f"trace file {path!r} has no traceEvents list")
+    return doc
+
+
 def merge_chrome_traces(paths: Sequence[str],
-                        out_path: Optional[str] = None
+                        out_path: Optional[str] = None,
+                        strict: bool = False
                         ) -> Tuple[dict, dict]:
     """Stitch per-process chrome-trace files into ONE timeline.
 
     Every "X" span of every input survives verbatim (the caller can —
-    and chaos drills do — fail hard when `spans_out != spans_in`).
-    Process identity is kept distinct: if two files claim the same pid
-    but different process names (a restarted worker recycling a pid, or
-    two single-process drills merged after the fact), the later file's
-    events are remapped onto a fresh synthetic pid. Metadata ("M")
-    events are deduplicated per (pid, name, tid).
+    and chaos drills do — fail hard when `spans_out != spans_in`;
+    `strict=True` makes the merge itself raise RuntimeError on that
+    mismatch). Process identity is kept distinct: if two files claim
+    the same pid but different process names (a restarted worker
+    recycling a pid, or two single-process drills merged after the
+    fact), the later file's events are remapped onto a fresh synthetic
+    pid. Metadata ("M") events are deduplicated per (pid, name, tid).
+    Empty or malformed input files raise ValueError naming the file
+    (`load_chrome_trace`).
 
     Returns (merged_doc, stats) where stats carries per-file and total
     span counts; `out_path` additionally writes the merged JSON."""
@@ -228,8 +285,7 @@ def merge_chrome_traces(paths: Sequence[str],
     used_pids = set()
     stats = {"files": {}, "spans_in": 0, "spans_out": 0, "processes": []}
     for path in paths:
-        with open(path) as f:
-            doc = json.load(f)
+        doc = load_chrome_trace(path)
         events = doc.get("traceEvents", [])
         # span budget counted straight off the LOADED file, independent
         # of the transform loop below — so the spans_out gate actually
@@ -280,6 +336,10 @@ def merge_chrome_traces(paths: Sequence[str],
     doc = {"traceEvents": merged_meta + merged_spans,
            "displayTimeUnit": "ms"}
     stats["spans_out"] = len(merged_spans)
+    if strict and stats["spans_out"] != stats["spans_in"]:
+        raise RuntimeError(
+            f"merge dropped spans: {stats['spans_in']} in, "
+            f"{stats['spans_out']} out across {len(list(paths))} files")
     if out_path is not None:
         with open(out_path, "w") as f:
             json.dump(doc, f)

@@ -33,6 +33,20 @@ from .bucketing import concat_requests, pad_rows, plan_request
 from .errors import (BadRequestError, DeadlineExceededError,
                      ModelUnavailableError, QueueFullError, ServeError)
 
+# observe-flag probe for submit(), memoized on the flag registry version
+# (same idiom as xray._trace_on): submit runs once per request, and at
+# serve rates the registry dict lookups are measurable in the horizon A/B
+_observe_cache = (-1, False)
+
+
+def _observe_on() -> bool:
+    global _observe_cache
+    ver = _flags.version()
+    cached = _observe_cache
+    if cached[0] != ver:
+        cached = _observe_cache = (ver, bool(_flags.get_flag("observe")))
+    return cached[1]
+
 
 class _Request:
     __slots__ = ("planned", "future", "deadline", "t_enq", "ctx", "ts_wall")
@@ -187,7 +201,7 @@ class MicroBatcher:
 
     def submit(self, feed, deadline_ms: Optional[float] = None) -> Future:
         """Plan, admit and enqueue one request; returns its Future."""
-        ctx = _xray.child_of() if _flags.get_flag("observe") else None
+        ctx = _xray.child_of() if _observe_on() else None
         ts_wall = time.time() if ctx is not None else 0.0
         t_sub = time.monotonic()
         # cheap pre-check BEFORE planning: under overload the fast-reject
@@ -248,13 +262,23 @@ class MicroBatcher:
         else:
             self._m_requests.inc(model=self._name, outcome="cancelled")
 
-    def _req_span(self, req: _Request, outcome: str, **args):
-        """Close the request's lifecycle span (submit -> resolution)."""
+    def _req_span(self, req: _Request, outcome: str, batch_span=None,
+                  **args):
+        """Close the request's lifecycle span (submit -> resolution).
+        Records straight into the tracer ring (no record_span hop) —
+        this runs once per served request on the executor thread,
+        BEFORE the future resolves, so every microsecond here delays
+        the caller's wakeup (the horizon A/B prices it)."""
         if req.ctx is not None:
-            _xray.record_span("serve_request", req.ctx, req.ts_wall,
-                              time.monotonic() - req.t_enq, cat="serve",
-                              model=self._name, outcome=outcome,
-                              rows=req.planned.rows, **args)
+            extra = {"model": self._name, "outcome": outcome,
+                     "rows": req.planned.rows}
+            if batch_span is not None:
+                extra["batch_span"] = batch_span
+            if args:
+                extra.update(args)
+            _xray.tracer().record_ctx(
+                "serve_request", req.ts_wall,
+                time.monotonic() - req.t_enq, "serve", req.ctx, extra)
 
     # -- executor side ---------------------------------------------------
 
@@ -408,25 +432,42 @@ class MicroBatcher:
             feeds, rows = concat_requests([r.planned for r in batch])
             target = ver.ladder.rows_rung(rows)
             padded = pad_rows(feeds, rows, target)
-            if ver.sparse_plan is not None:
-                # fluid-fleet: pull this BATCH's unique embedding rows
-                # from the pserver shards (row-cache first) and feed them
-                # as fixed-shape sub-tables with ids remapped — after
-                # padding, so the fed shapes match the warmed signature
-                padded = ver.sparse_plan.augment(padded)
             # fluid-xray batch span: the ONE prepared step serving these
             # coalesced requests. Parented to the oldest request's trace
             # (the one that waited longest for this batch); the other
             # members are linked through `traces` and each request's own
-            # lifecycle span carries `batch_span` back to it.
+            # lifecycle span carries `batch_span` back to it. Computed
+            # BEFORE the sparse augment and made AMBIENT around it: the
+            # augment's PSClient row pulls run on THIS executor thread,
+            # and without the activation they would start fresh traces
+            # instead of joining the router -> replica -> pserver chain
+            # (fluid-horizon's e2e stitch pins exactly this edge).
             bctx = None
-            if any(r.ctx is not None for r in batch):
-                parent = next(r.ctx for r in batch if r.ctx is not None)
-                bctx = _xray.child_of(parent)
-            ts_wall = time.time()
-            t0 = time.perf_counter()
-            fetches = ver.prepared.run(padded)
-            dt = time.perf_counter() - t0
+            for r in batch:
+                if r.ctx is not None:
+                    bctx = _xray.child_of(r.ctx)
+                    break
+            # ambient activation exists FOR the sparse augment's PSClient
+            # spans; a dense model runs nothing that reads the ambient
+            # context, so skip the ContextVar set/reset on its hot path
+            token = (_xray.set_current(bctx)
+                     if bctx is not None and ver.sparse_plan is not None
+                     else None)
+            try:
+                if ver.sparse_plan is not None:
+                    # fluid-fleet: pull this BATCH's unique embedding
+                    # rows from the pserver shards (row-cache first) and
+                    # feed them as fixed-shape sub-tables with ids
+                    # remapped — after padding, so the fed shapes match
+                    # the warmed signature
+                    padded = ver.sparse_plan.augment(padded)
+                ts_wall = time.time()
+                t0 = time.perf_counter()
+                fetches = ver.prepared.run(padded)
+                dt = time.perf_counter() - t0
+            finally:
+                if token is not None:
+                    _xray.unset_current(token)
             # a version loaded with warm=False becomes "warmed" by
             # serving (it compiled on demand): /readyz must not report a
             # once-cold-but-now-serving standalone deployment unready
@@ -435,12 +476,17 @@ class MicroBatcher:
             # ("no compiles on routed traffic") holds where it matters.
             ver.warmed = True
             if bctx is not None:
-                _xray.record_span(
-                    "serve_batch", bctx, ts_wall, dt, cat="serve",
-                    model=self._name, requests=len(batch), rows=rows,
-                    padded_rows=target,
-                    traces=[r.ctx.trace_id for r in batch[:8]
-                            if r.ctx is not None])
+                extra = {"model": self._name, "requests": len(batch),
+                         "rows": rows, "padded_rows": target}
+                if len(batch) > 1:
+                    # cross-links to the other members' traces — when
+                    # there IS more than one (a lone request's trace is
+                    # already the batch span's parent, and at occupancy
+                    # 1 this list would be pure hot-path overhead)
+                    extra["traces"] = [r.ctx.trace_id for r in batch[:8]
+                                       if r.ctx is not None]
+                _xray.tracer().record_ctx("serve_batch", ts_wall, dt,
+                                          "serve", bctx, extra)
             self._m_batch_latency.observe(dt * 1e6, model=self._name)
             self._m_occupancy.observe(len(batch), model=self._name)
             self._m_rows.observe(rows, model=self._name)
@@ -460,9 +506,16 @@ class MicroBatcher:
                 self._m_requests.inc(model=self._name, outcome="ok")
                 self._m_latency.observe((done - r.t_enq) * 1e6,
                                         model=self._name)
+                # batch_span back-links a request to the batch it rode in
+                # — only meaningful when it shared the batch (at
+                # occupancy 1 the request's own span is the batch span's
+                # parent, and resolving bctx.span_id here would pay the
+                # lazy-id mint on the hot path for a redundant edge)
                 self._req_span(
                     r, "ok",
-                    **({"batch_span": bctx.span_id} if bctx else {}))
+                    batch_span=(bctx.span_id
+                                if bctx is not None and len(batch) > 1
+                                else None))
                 # fluid-fleet: tag the resolving Future with the version
                 # that actually EXECUTED this request — the replica RPC
                 # layer returns it so the router's skew gate can prove a

@@ -67,12 +67,12 @@ def test_update_log_watermark_window_and_degradation():
         assert log.append("push_grad", {"i": i}) == i + 1
     assert log.lag() == 4
     batch = log.batch()
-    assert [s for s, _c, _p in batch] == [1, 2, 3, 4]
+    assert [s for s, _c, _p, _tr in batch] == [1, 2, 3, 4]
     log.ack(2)
     assert log.lag() == 2
-    assert [s for s, _c, _p in log.batch()] == [3, 4]
+    assert [s for s, _c, _p, _tr in log.batch()] == [3, 4]
     # retransmit: batch() keeps returning unacked records
-    assert [s for s, _c, _p in log.batch()] == [3, 4]
+    assert [s for s, _c, _p, _tr in log.batch()] == [3, 4]
     # window full + more appends: blocked appenders release on ack
     log.append("push_grad", {})
     log.append("push_grad", {})   # lag back to 4 == window

@@ -66,6 +66,10 @@ def main(argv=None):
     ap.add_argument("--device-ms", type=float, default=0.0,
                     help="REHEARSAL ONLY: simulated per-request device "
                     "time (sleep) — see ReplicaServer docstring")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="turn the observe flag on and export this "
+                    "process's chrome trace here at clean shutdown "
+                    "(fluid-horizon stitches one per fleet process)")
     args = ap.parse_args(argv)
 
     import jax
@@ -77,7 +81,7 @@ def main(argv=None):
 
     rid = args.replica_id or f"r{os.getpid()}"
     xray.set_process_name(f"replica-{rid}")
-    if args.pulse_port is not None:
+    if args.pulse_port is not None or args.trace_out:
         fluid.set_flag("observe", True)
 
     srv = serve.InferenceServer(
@@ -116,6 +120,9 @@ def main(argv=None):
     signal.signal(signal.SIGINT, _term)
     done.wait()
     rep.close()
+    if args.trace_out:
+        from paddle_tpu.observe import get_tracer
+        get_tracer().export_chrome(args.trace_out)
     return 0
 
 

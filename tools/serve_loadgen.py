@@ -591,6 +591,27 @@ def main(argv=None):
                     help="per-request deadline (default none)")
     ap.add_argument("--no-swap", action="store_true",
                     help="skip the mid-run hot-swap drill")
+    ap.add_argument("--no-observe", action="store_true",
+                    help="oneshot workload: leave the observe flag OFF "
+                    "entirely — no metrics, no spans (recompile gating "
+                    "still works; compile events record regardless)")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="oneshot workload: observe stays ON (metrics, "
+                    "pulse) but the `trace` flag goes off — no span ids, "
+                    "no recording, legacy wire frames. The baseline half "
+                    "of bench.py's fluid-horizon trace-overhead A/B: "
+                    "both halves pay for metrics, the delta prices trace "
+                    "context alone")
+    ap.add_argument("--trace-ab", type=int, default=0, metavar="ROUNDS",
+                    help="oneshot workload: PAIRED in-process trace A/B "
+                    "— after warmup, alternate the `trace` flag off/on "
+                    "across 2*ROUNDS open-loop phases in THIS process "
+                    "and report the paired p50 delta. Pairing inside "
+                    "one process controls the between-process variance "
+                    "(allocator layout, CPU frequency) that dwarfs a "
+                    "tens-of-microseconds effect when separate "
+                    "subprocess runs are compared; bench.py's horizon "
+                    "gate reads this")
     ap.add_argument("--replicas", type=int, default=0, metavar="N",
                     help="fluid-fleet mode: spawn N replica SUBPROCESSES "
                     "behind a FleetRouter and drive the open loop "
@@ -626,6 +647,12 @@ def main(argv=None):
                      "workload only")
         return run_generate(args)
 
+    if args.trace_ab and (args.no_observe or args.no_trace):
+        # the A/B owns the trace flag; a pre-disarmed plane would make
+        # both halves identical and the "overhead" a pure-noise reading
+        ap.error("--trace-ab flips the trace flag itself; drop "
+                 "--no-observe/--no-trace")
+
     import jax
     jax.config.update("jax_platforms", "cpu")
     import numpy as np
@@ -633,7 +660,9 @@ def main(argv=None):
     import paddle_tpu as fluid
     from paddle_tpu import observe, serve
 
-    fluid.set_flag("observe", True)
+    fluid.set_flag("observe", not args.no_observe)
+    if args.no_trace:
+        fluid.set_flag("trace", False)
 
     mdir = args.model_dir
     if mdir is None:
@@ -690,6 +719,93 @@ def main(argv=None):
                 rejected[0] += 1
             else:
                 failures.append(repr(e))
+
+    if args.trace_ab:
+        # ---- paired in-process trace A/B (fluid-horizon gate) ----------
+        # Alternate the `trace` flag off/on across open-loop phases in
+        # THIS process and compare PAIRED p50s. Two separate loadgen
+        # subprocesses differ by tens of microseconds from allocator
+        # layout and CPU frequency alone — more than the tracing effect
+        # under test — while consecutive phases of one warmed process
+        # share all of that, so the per-round (on - off) delta isolates
+        # the trace cost. Median-of-rounds on both the delta and the
+        # baseline keeps one descheduled phase from deciding the gate.
+        def ab_phase(seconds: float) -> list:
+            lats = []
+            lat_lock = threading.Lock()
+            stop_at = time.perf_counter() + seconds
+            gap = args.threads / args.qps if args.qps > 0 else 0.0
+
+            def client():
+                prng = random.Random(threading.get_ident())
+                while time.perf_counter() < stop_at:
+                    if gap > 0:
+                        time.sleep(prng.expovariate(1.0 / gap))
+                    t0 = time.perf_counter()
+                    try:
+                        srv.infer("m", make_feed(),
+                                  deadline_ms=args.deadline_ms)
+                    except Exception as e:
+                        record_failure(e)
+                        continue
+                    with lat_lock:
+                        lats.append((time.perf_counter() - t0) * 1e6)
+
+            ths = [threading.Thread(target=client, daemon=True)
+                   for _ in range(args.threads)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join(timeout=seconds + 15)
+            return lats
+
+        def p50(lats: list) -> float:
+            lats = sorted(lats)
+            return lats[len(lats) // 2] if lats else 0.0
+
+        # Each round is an ABBA block — off,on,on,off (mirrored on odd
+        # rounds) — because the process's latency floor WANDERS over a
+        # run by more than the effect under test (CPU frequency,
+        # allocator growth, neighbor load): a fixed off-then-on order
+        # turns any drift into systematic bias, and plain alternation
+        # only cancels drift that is linear ACROSS rounds. ABBA cancels
+        # linear drift exactly WITHIN each block; both same-arm phases
+        # pool their raw samples so each block yields one well-sampled
+        # paired p50 delta, and the gate reads the median over blocks.
+        rounds = max(1, args.trace_ab)
+        phase_s = max(0.5, args.duration / (4 * rounds))
+        ab_phase(min(1.0, phase_s))            # settle after warmup
+        offs, ons = [], []
+        for i in range(rounds):
+            seq = ((False, True, True, False) if i % 2 == 0
+                   else (True, False, False, True))
+            offl, onl = [], []
+            for flag in seq:
+                fluid.set_flag("trace", flag)
+                (onl if flag else offl).extend(ab_phase(phase_s))
+            offs.append(p50(offl))
+            ons.append(p50(onl))
+        by_round = [b - a for a, b in zip(offs, ons)]
+        diffs = sorted(by_round)
+        off_med = sorted(offs)[rounds // 2]
+        on_med = sorted(ons)[rounds // 2]
+        diff_med = diffs[rounds // 2]
+        overhead = diff_med / off_med if off_med > 0 else -1.0
+        print(f"trace A/B: {rounds} ABBA blocks of 4x{phase_s:.1f}s, "
+              f"p50 off {off_med:.0f} us, paired delta {diff_med:+.0f} us "
+              f"({overhead * 100:+.2f}%); per-round deltas "
+              f"{[round(d, 1) for d in by_round]}", file=sys.stderr)
+        print(json.dumps({
+            "serve_p50_us_trace_off": round(off_med, 1),
+            "serve_p50_us_trace_on": round(on_med, 1),
+            "trace_p50_delta_us": round(diff_med, 1),
+            "trace_overhead_pct": round(overhead * 100.0, 2),
+            "trace_ab_rounds": rounds,
+            "serve_failed": len(failures),
+            "serve_rejected": rejected[0],
+        }))
+        srv.close()
+        return 0 if not failures else 1
 
     # ---- phase 1: closed loop (saturation / coalescing) ----------------
     closed_lat = []
