@@ -316,6 +316,7 @@ BENCH_SEGMENTS = (
     "serve_loadgen_subprocess",
     "decode_loadgen_subprocess",
     "fleet_subprocess",
+    "torrent_subprocess",
     "wire_bench_subprocess",
     "haven_subprocess",
     "quorum_subprocess",
@@ -624,6 +625,63 @@ def fleet_subprocess():
               file=sys.stderr)
         out["fleet_p99_under_kill_us"] = 0.0
         out["fleet_kill_failed_requests"] = -1
+    return out
+
+
+def torrent_subprocess():
+    """fluid-torrent numbers (tools/torrent_bench.py + the decode_kill
+    chaos drill): the disaggregated serving plane (1 prefill + 2 decode
+    replicas, int8 KV residency, wire-streamed KV) vs the pre-torrent
+    co-located fp32 baseline at a FIXED fleet size and a FIXED per-chip
+    KV byte budget. Acceptance: the torrent arm wins BOTH lower TTFT
+    p99 AND higher tokens/s/chip (gains > 1.0) with zero failed and
+    zero token-divergent generations and the KV transfer bytes metered,
+    and the decode_kill drill loses zero completed tokens across a
+    mid-generation decode-replica SIGKILL (re-prefill failover).
+
+    Device-cost honesty as in fleet_subprocess: replicas simulate the
+    two TPU cost shapes (compute-bound prefill us/token, memory-bound
+    decode us/STEP — the decode batch rides one HBM sweep) so a 1-core
+    rig prices what disaggregation actually moves: which chip pays the
+    prefill stall and how many resident sequences amortize each decode
+    sweep."""
+    import subprocess
+
+    res, rc = _tool_json("torrent_bench.py", "torrent bench",
+                         args=("--duration", "6", "--clients", "12"),
+                         timeout=480)
+    if res is None:
+        return {"torrent_throughput_gain_x": 0.0,
+                "torrent_ttft_p99_gain_x": 0.0,
+                "torrent_failed": -1, "torrent_divergent": -1}
+    out = dict(res)
+    out["torrent_bench_ok"] = (
+        rc == 0 and res.get("torrent_throughput_gain_x", 0.0) > 1.0
+        and res.get("torrent_ttft_p99_gain_x", 0.0) > 1.0)
+    # the decode_kill drill: SIGKILL a decode replica mid-generation;
+    # session-affinity failover must re-prefill onto a survivor with
+    # zero failed generations and zero token divergence
+    try:
+        drill = subprocess.run(
+            [sys.executable, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools", "chaos_drill.py"),
+             "--scenario", "decode_kill"],
+            capture_output=True, text=True, timeout=300)
+        line = [l for l in drill.stdout.splitlines()
+                if l.startswith("{")][-1]
+        kill = json.loads(line)
+        out["torrent_decode_kill_failed"] = kill.get(
+            "decode_kill_failed", -1)
+        out["torrent_decode_kill_divergent"] = kill.get(
+            "decode_kill_divergent", -1)
+        out["torrent_decode_kill_failovers"] = kill.get(
+            "decode_kill_failovers", -1)
+        if drill.returncode:
+            out["torrent_decode_kill_rc"] = drill.returncode
+    except Exception as e:
+        print(f"WARNING: decode_kill drill failed ({e!r})",
+              file=sys.stderr)
+        out["torrent_decode_kill_failed"] = -1
     return out
 
 
@@ -1151,6 +1209,11 @@ def main(argv=None):
     # SIGKILL with zero failed requests, DeepFM-from-pserver-shards
     fleet_rec = seg("fleet_subprocess", fleet_subprocess, {})
     note(**fleet_rec)
+    # fluid-torrent: disaggregated (1 prefill + 2 decode, int8 KV) vs
+    # co-located fp32 at fixed fleet size + fixed per-chip KV budget
+    # (acceptance: wins BOTH TTFT p99 and tokens/s/chip) + decode_kill
+    torrent_rec = seg("torrent_subprocess", torrent_subprocess, {})
+    note(**torrent_rec)
     # fluid-wire: quantized PS wire A/B (bytes/step raw vs encoded, sync-PS
     # step time both modes, sparse-row compression, loss-delta neutrality)
     wirebench = seg("wire_bench_subprocess", wire_bench_subprocess, {})

@@ -45,9 +45,13 @@ from ..observe import xray as _xray
 from ..pserver import rpc as _rpc
 from ..serve.errors import ServeError
 from ..serve.server import InferenceServer
+from ..torrent.prefill import prefill_and_stream
+from ..torrent.stream import KVStreamReceiver
 from . import wire as _wire
 
 logger = logging.getLogger(__name__)
+
+_ROLES = ("prefill", "decode", "both")
 
 
 class ReplicaServer(_wire.HardCutServer):
@@ -57,7 +61,8 @@ class ReplicaServer(_wire.HardCutServer):
                  lease_s: float = 3.0,
                  simulate_device_ms: float = 0.0,
                  quorum=None,
-                 quorum_member_prefix: str = "fleet-member:"):
+                 quorum_member_prefix: str = "fleet-member:",
+                 role: str = "both"):
         """`quorum` (fluid-quorum, a `QuorumClient`) makes this
         replica's membership partition-safe: each heartbeat round also
         renews its OWN lease at the arbiter group under
@@ -72,11 +77,23 @@ class ReplicaServer(_wire.HardCutServer):
         standing in for the TPU device time a real replica spends off
         the host CPU. It is what lets the multi-replica loadgen measure
         ROUTER/RPC scaling on a 1-core rig — the drill records it, and
-        it must be 0 in any real deployment."""
+        it must be 0 in any real deployment.
+
+        `role` is the fluid-torrent pool assignment this replica
+        advertises (heartbeat + readiness): "prefill" and "decode"
+        replicas take only their half of disaggregated traffic from
+        `FleetRouter.generate_torrent`; "both" (default) is eligible for
+        everything, including classic co-located `generate`. The role is
+        a ROUTING hint, not an enforcement boundary — every handler
+        stays available, so an operator can drain a pool by re-roling
+        without stranding in-flight work."""
         super().__init__()
+        if role not in _ROLES:
+            raise ValueError(f"role must be one of {_ROLES}, got {role!r}")
         self.server = server
         self.replica_id = replica_id or f"r-{uuid.uuid4().hex[:8]}"
         self.session = uuid.uuid4().hex
+        self.role = role
         self.router_endpoint = router_endpoint
         self.lease_s = float(lease_s)
         self.simulate_device_s = max(0.0, float(simulate_device_ms)) / 1e3
@@ -90,6 +107,12 @@ class ReplicaServer(_wire.HardCutServer):
         self.quorum_member_prefix = str(quorum_member_prefix)
         self._heartbeat: Optional[HeartbeatThread] = None
         self._router_pool: Optional[_wire.ConnPool] = None
+        # fluid-torrent: the decode half's staging table, and the
+        # prefill half's connection pools to decode replicas
+        self._kv_recv = KVStreamReceiver(self._torrent_admit)
+        self._torrent_lock = threading.Lock()
+        # guarded_by: self._torrent_lock — decode endpoint -> ConnPool
+        self._torrent_pools = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -120,6 +143,7 @@ class ReplicaServer(_wire.HardCutServer):
             "session": self.session,
             "pulse_port": self.server.pulse_port,
             "lease_s": self.lease_s,
+            "role": self.role,
         }, deadline_s=min(self.lease_s, 2.0))
 
     def kill(self):
@@ -149,6 +173,11 @@ class ReplicaServer(_wire.HardCutServer):
                 except Exception:
                     pass   # lease expiry covers an unreachable router
             self._router_pool.close()
+        with self._torrent_lock:
+            pools = list(self._torrent_pools.values())
+            self._torrent_pools.clear()
+        for p in pools:
+            p.close()
         self._hard_cut()
 
     def close(self):
@@ -240,7 +269,104 @@ class ReplicaServer(_wire.HardCutServer):
                        "version": res.version_id,
                        "version_key": ver_key,
                        "ttft_us": res.ttft_us,
+                       # engine-observed TTFT rides FleetResult.outs so
+                       # fleet callers (torrent_bench's co-located arm)
+                       # can compare first-token latency across modes
+                       "outs": {"ttft_us": res.ttft_us,
+                                "finish_reason": res.finish_reason},
                        "replica_id": self.replica_id})
+
+    # -- fluid-torrent (disaggregated generation halves) -------------------
+
+    def _torrent_pool(self, endpoint: str) -> _wire.ConnPool:
+        with self._torrent_lock:
+            pool = self._torrent_pools.get(endpoint)
+            if pool is None:
+                pool = self._torrent_pools[endpoint] = _wire.ConnPool(
+                    endpoint, max_idle=2)
+            return pool
+
+    def _torrent_admit(self, model, prompt, first_token, kv, max_new,
+                       trace):
+        """KVStreamReceiver admit hook: inject the wire-delivered
+        payload into this replica's decode engine. The kv_begin record's
+        trace context (the ORIGINATING routed request) is activated
+        around the submit so the decode engine's serve_generate span
+        stitches into the same trace as the prefill half."""
+        wctx = (_xray.from_wire(trace)
+                if _flags.get_flag("observe") and trace else None)
+        if wctx is not None:
+            with _xray.activate(wctx):
+                return self.server.submit_prefilled(
+                    model, prompt, first_token, kv,
+                    max_new_tokens=max_new)
+        return self.server.submit_prefilled(
+            model, prompt, first_token, kv, max_new_tokens=max_new)
+
+    def _h_torrent_prefill(self, model, prompt, seq_id, decode_endpoint,
+                           max_new_tokens=16, deadline_ms=None):
+        """Prefill half: run the prompt here, stream its KV blocks to
+        `decode_endpoint`'s `torrent_kv` handler. The router dispatches
+        this least-loaded over the prefill pool; a KVTransferError reply
+        means the DECODE side is gone — the router re-pins and retries,
+        it does not shed this to another prefill replica."""
+        trace = None
+        if _flags.get_flag("observe") and _xray.current() is not None:
+            trace = _xray.to_wire(_xray.current())
+        pool = self._torrent_pool(decode_endpoint)
+
+        def send(records):
+            value = _wire.call(pool, "torrent_kv", {"records": records},
+                               deadline_s=min(
+                                   self.lease_s * 2, 10.0))
+            return int(value["acked"])
+
+        out = prefill_and_stream(
+            self.server, model, prompt, int(max_new_tokens), seq_id,
+            send, deadline_ms=deadline_ms, trace=trace)
+        # no simulate_device_s sleep here: torrent rehearsals price
+        # device time with the serve engine's phase-shaped knobs
+        # (simulate_prefill_us_per_token / simulate_decode_step_us),
+        # which already ran inside prefill_and_stream — sleeping again
+        # under _device_lock would double-charge the prefill
+        # the summary rides FleetResult.outs (torrent_prefill is a
+        # control reply, not a fetch list)
+        return ("ok", {"outs": out, "replica_id": self.replica_id})
+
+    def _h_torrent_kv(self, records):
+        """Decode half, transfer plane: apply one record batch, reply
+        the contiguous acked watermark (the sender's resume point)."""
+        return ("ok", self._kv_recv.handle(records))
+
+    def _h_torrent_collect(self, model, seq_id, deadline_ms=None):
+        """Decode half, result plane: block until the injected
+        generation finishes, reply its tokens (shaped like generate so
+        the router's FleetResult mapping is shared). Collecting releases
+        the staging — collect-once semantics."""
+        fut = self._kv_recv.future(seq_id)
+        timeout = 60.0 if deadline_ms is None else deadline_ms / 1e3 + 30.0
+        res = fut.result(timeout=timeout)
+        self._kv_recv.release(seq_id)
+        ver_key = None
+        try:
+            cur = self.server.registry.get(model)
+            if cur.version_id == res.version_id:
+                ver_key = cur.version_key
+        except Exception:
+            pass
+        return ("ok", {"tokens": list(res.tokens),
+                       "finish_reason": res.finish_reason,
+                       "version": res.version_id,
+                       "version_key": ver_key,
+                       "ttft_us": res.ttft_us,
+                       "replica_id": self.replica_id})
+
+    def _h_torrent_cancel(self, seq_id):
+        """Drop a transfer's staging/future (router released the
+        session). The generation itself, if already admitted, runs to
+        completion on the engine — cancel severs the collect path."""
+        self._kv_recv.release(seq_id)
+        return ("ok", {"released": True})
 
     # -- readiness / stats -------------------------------------------------
 
@@ -252,6 +378,7 @@ class ReplicaServer(_wire.HardCutServer):
                 "replica_id": self.replica_id,
                 "session": self.session,
                 "models": detail,
+                "role": self.role,
                 "pulse_port": self.server.pulse_port}
 
     def _h_readyz(self):

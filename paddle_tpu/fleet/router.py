@@ -40,8 +40,9 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import uuid
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from .. import flags as _flags
 from ..ark.liveness import LeaseTable, QuorumLeaseTable
@@ -49,8 +50,8 @@ from ..ark.retry import RetryPolicy
 from ..observe import metrics as _metrics
 from ..observe import xray as _xray
 from ..pserver import rpc as _rpc
-from ..serve.errors import (DeadlineExceededError, ModelUnavailableError,
-                            ServeError)
+from ..serve.errors import (DeadlineExceededError, KVTransferError,
+                            ModelUnavailableError, ServeError)
 from . import wire as _wire
 
 logger = logging.getLogger(__name__)
@@ -117,6 +118,9 @@ class _Member:
         self.pulse_port = pulse_port
         self.pool = _wire.ConnPool(endpoint, max_idle=pool_max_idle)
         self.session: Optional[str] = None
+        # fluid-torrent pool assignment ("prefill"|"decode"|"both"),
+        # advertised by heartbeat/readiness; "both" = no restriction
+        self.role = "both"
         # readiness state, written by the poller (and by failover marks)
         self.ready = False
         self.models: Dict[str, dict] = {}
@@ -174,6 +178,27 @@ class FleetRouter(_wire.HardCutServer):
             "fleet_replicas_registered", "replicas holding a live lease")
         self._m_swaps = _metrics.counter(
             "fleet_swaps_total", "coordinated swaps by outcome")
+        # fluid-torrent session affinity: a generating sequence pins to
+        # its decode replica for the generation's life
+        # guarded_by: self._lock — seq_id -> (replica_id, model)
+        self._affinity: Dict[str, Tuple[str, str]] = {}
+        self._m_affinity = _metrics.gauge(
+            "fleet_affinity_sessions",
+            "generating sequences pinned to a decode replica")
+        self._m_affinity_released = _metrics.counter(
+            "fleet_affinity_released_total",
+            "session pins released, by model/reason")
+        self._m_tg = _metrics.counter(
+            "torrent_generations_total",
+            "disaggregated generations by model/outcome")
+        self._m_tg_failovers = _metrics.counter(
+            "torrent_failovers_total",
+            "pinned decode replicas replaced mid-generation "
+            "(re-prefill failover), per model")
+        self._m_tg_ttft = _metrics.histogram(
+            "torrent_ttft_us",
+            "end-to-end disaggregated TTFT: route + prefill + KV "
+            "stream, per model")
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -232,7 +257,7 @@ class FleetRouter(_wire.HardCutServer):
         return rid
 
     def _register(self, replica_id, endpoint, pulse_port, session,
-                  lease_s):
+                  lease_s, role=None):
         with self._lock:
             m = self._members.get(replica_id)
             if m is None or m.endpoint != endpoint:
@@ -243,6 +268,8 @@ class FleetRouter(_wire.HardCutServer):
                 self._members[replica_id] = m
             if pulse_port is not None:
                 m.pulse_port = pulse_port
+            if role:
+                m.role = role
             if session is not None and m.session != session:
                 # a RESTARTED replica process re-registered under the
                 # same id: clear the suspect mark, force a fresh poll
@@ -253,6 +280,10 @@ class FleetRouter(_wire.HardCutServer):
     def remove_replica(self, replica_id: str) -> bool:
         with self._lock:
             m = self._members.pop(replica_id, None)
+            pinned = [sid for sid, (rid, _mod) in self._affinity.items()
+                      if rid == replica_id]
+        for sid in pinned:
+            self.release_session(sid, "death")
         self._lease.forget(replica_id)
         if m is not None:
             m.close()
@@ -268,6 +299,7 @@ class FleetRouter(_wire.HardCutServer):
                 "ready": m.ready and not m.suspect,
                 "suspect": m.suspect,
                 "inflight": m.inflight,
+                "role": m.role,
                 "models": dict(m.models),
                 "pulse_port": m.pulse_port,
             } for rid, m in self._members.items()}
@@ -277,15 +309,20 @@ class FleetRouter(_wire.HardCutServer):
         with self._lock:
             return [m for rid, m in self._members.items() if rid in live]
 
-    def ready_members(self, model: str) -> List[_Member]:
+    def ready_members(self, model: str,
+                      role: Optional[str] = None) -> List[_Member]:
         """Members allowed to take `model` traffic: live lease, ready
         verdict, not suspect, model present+warmed, and — once a swap
-        committed a fleet version — the matching version_key."""
+        committed a fleet version — the matching version_key. `role`
+        (fluid-torrent) keeps only members of that pool; "both" members
+        always qualify."""
         with self._lock:
             want = self._desired.get(model)
         out = []
         for m in self._live_members():
             if not m.ready or m.suspect:
+                continue
+            if role is not None and m.role not in (role, "both"):
                 continue
             d = m.models.get(model)
             if not d or not d.get("warmed"):
@@ -336,6 +373,8 @@ class FleetRouter(_wire.HardCutServer):
         with self._lock:
             m.ready = doc.get("status") == "ok"
             m.models = dict(doc.get("models") or {})
+            if doc.get("role"):
+                m.role = doc["role"]
             m.suspect = False
             m.last_poll = time.monotonic()
         # probe evidence of liveness: a poll that answered renews the
@@ -396,7 +435,8 @@ class FleetRouter(_wire.HardCutServer):
         if cmd == "replica_heartbeat":
             self._register(p["replica_id"], p["endpoint"],
                            p.get("pulse_port"), p.get("session"),
-                           float(p.get("lease_s") or self.config.lease_s))
+                           float(p.get("lease_s") or self.config.lease_s),
+                           role=p.get("role"))
             with self._lock:
                 n_members = len(self._members)
             return ("ok", {"members": n_members})
@@ -419,10 +459,11 @@ class FleetRouter(_wire.HardCutServer):
                 g.set()
             return g
 
-    def _pick(self, model: str, exclude: set) -> Optional[_Member]:
+    def _pick(self, model: str, exclude: set,
+              role: Optional[str] = None) -> Optional[_Member]:
         """Least-loaded among ready members: router in-flight plus the
         last-polled queue depth; round-robin among ties."""
-        cands = [m for m in self.ready_members(model)
+        cands = [m for m in self.ready_members(model, role=role)
                  if m.replica_id not in exclude]
         if not cands:
             return None
@@ -435,7 +476,8 @@ class FleetRouter(_wire.HardCutServer):
             self._rr += 1
             return tied[self._rr % len(tied)]
 
-    def _request(self, model: str, cmd: str, payload: dict) -> FleetResult:
+    def _request(self, model: str, cmd: str, payload: dict,
+                 role: Optional[str] = None) -> FleetResult:
         """The routed request core: gate, pick, call, classify, retry.
 
         fluid-horizon entry point: with the observe flag on, the whole
@@ -446,11 +488,11 @@ class FleetRouter(_wire.HardCutServer):
         if _flags.get_flag("observe"):
             with _xray.span(f"fleet:{cmd}", cat="fleet", model=model,
                             cmd=cmd):
-                return self._request_inner(model, cmd, payload)
-        return self._request_inner(model, cmd, payload)
+                return self._request_inner(model, cmd, payload, role)
+        return self._request_inner(model, cmd, payload, role)
 
-    def _request_inner(self, model: str, cmd: str,
-                       payload: dict) -> FleetResult:
+    def _request_inner(self, model: str, cmd: str, payload: dict,
+                       role: Optional[str] = None) -> FleetResult:
         payload = {"model": model, **payload}
         gate_deadline = time.monotonic() + \
             self.config.swap_drain_timeout_s + 5.0
@@ -481,7 +523,7 @@ class FleetRouter(_wire.HardCutServer):
         last_err: Optional[BaseException] = None
         try:
             while True:
-                m = self._pick(model, exclude)
+                m = self._pick(model, exclude, role=role)
                 if m is None and not exclude and \
                         attempt <= self.retry.max_attempts:
                     # nobody ready RIGHT NOW but nothing failed either
@@ -533,6 +575,17 @@ class FleetRouter(_wire.HardCutServer):
                         "fleet: %s failed %s (%r) — failing over",
                         m.replica_id, cmd, e)
                 except ServeError as e:
+                    if isinstance(e, KVTransferError):
+                        # the PREFILL half failed to deliver KV to its
+                        # pinned RECEIVER: rerouting the prefill to
+                        # another replica cannot fix a dead decode
+                        # replica. Propagate now — the torrent
+                        # orchestrator owns that failover (it releases
+                        # the pin and re-prefills against a fresh
+                        # decode replica).
+                        self._m_requests.inc(model=model,
+                                             outcome="kv_transfer")
+                        raise
                     if not getattr(e, "retriable", False) or \
                             isinstance(e, DeadlineExceededError):
                         # terminal (bad request, unknown model) — or a
@@ -577,6 +630,193 @@ class FleetRouter(_wire.HardCutServer):
             model, "generate",
             {"prompt": prompt, "max_new_tokens": max_new_tokens,
              "deadline_ms": deadline_ms})
+
+    # -- fluid-torrent: disaggregated generation ---------------------------
+
+    def pin_session(self, seq_id: str, model: str,
+                    exclude: frozenset = frozenset()) -> _Member:
+        """Pin a generative session to a decode replica (session
+        affinity): least-loaded among ready decode-pool members, held
+        until `release_session`. The pin is the decode half of a
+        disaggregated generation — the prefill replica streams KV to
+        exactly this member, and every subsequent hop (collect, cancel)
+        dispatches to it directly, no re-pick."""
+        m = self._pick(model, set(exclude), role="decode")
+        if m is None:
+            raise ModelUnavailableError(
+                f"model {model!r}: no ready decode replica to pin "
+                f"session {seq_id!r} (excluded: {sorted(exclude)})")
+        with self._lock:
+            self._affinity[seq_id] = (m.replica_id, model)
+            self._m_affinity.set(float(len(self._affinity)))
+        return m
+
+    def session_replica(self, seq_id: str) -> Optional[str]:
+        """The replica_id a session is pinned to, or None."""
+        with self._lock:
+            pin = self._affinity.get(seq_id)
+        return pin[0] if pin else None
+
+    def release_session(self, seq_id: str, reason: str) -> bool:
+        """Drop a session pin (EOS, length, cancel, error, or replica
+        death). Idempotent; returns whether a pin existed."""
+        with self._lock:
+            pin = self._affinity.pop(seq_id, None)
+            self._m_affinity.set(float(len(self._affinity)))
+        if pin is None:
+            return False
+        self._m_affinity_released.inc(model=pin[1], reason=reason)
+        return True
+
+    def _call_member(self, m: _Member, model: str, cmd: str,
+                     payload: dict, deadline_s: Optional[float] = None):
+        """Pinned dispatch: one wire call to a SPECIFIC member, no
+        pick, no retry, no shed — affinity means the request must land
+        here or fail so the orchestrator can re-pin. Counts against the
+        member's least-loaded in-flight but not the swap drain window
+        (see docs/TORRENT.md for why that's acceptable)."""
+        with self._lock:
+            m.inflight += 1
+        try:
+            return _wire.call(
+                m.pool, cmd, {"model": model, **payload},
+                deadline_s=deadline_s or self.config.request_deadline_s)
+        finally:
+            with self._lock:
+                m.inflight -= 1
+
+    def generate_torrent(self, model: str, prompt,
+                         max_new_tokens: int = 16,
+                         deadline_ms: Optional[float] = None,
+                         seq_id: Optional[str] = None) -> FleetResult:
+        """One DISAGGREGATED generation: pin a decode replica, route the
+        prefill half to the prefill pool (which streams KV straight to
+        the pinned member), then collect the finished tokens from the
+        decode replica.
+
+        Failover: a decode replica that dies mid-generation (transport
+        error on collect, KVTransferError from the stream, retriable
+        serve error) is excluded, the pin released, and the WHOLE
+        generation re-prefilled against a fresh decode replica — safe
+        because greedy decoding is deterministic, so the re-run
+        reproduces the identical token sequence: completed tokens are
+        never lost, only recomputed. Terminal errors propagate."""
+        sid = seq_id or f"tg-{uuid.uuid4().hex[:12]}"
+        if _flags.get_flag("observe"):
+            with _xray.span("fleet:torrent_generate", cat="fleet",
+                            model=model, seq=sid):
+                return self._generate_torrent_inner(
+                    model, prompt, max_new_tokens, deadline_ms, sid)
+        return self._generate_torrent_inner(
+            model, prompt, max_new_tokens, deadline_ms, sid)
+
+    def _generate_torrent_inner(self, model: str, prompt,
+                                max_new: int,
+                                deadline_ms: Optional[float],
+                                sid: str) -> FleetResult:
+        t0 = time.perf_counter()
+        bad_decodes: set = set()
+        attempt = 0
+        while True:
+            attempt += 1
+            # resolve the pin: reuse a live existing pin (resubmitted
+            # seq_id), else pick a fresh decode replica
+            m = None
+            with self._lock:
+                pin = self._affinity.get(sid)
+                if pin is not None:
+                    cand = self._members.get(pin[0])
+                    if cand is not None and \
+                            cand.replica_id not in bad_decodes:
+                        m = cand
+            if m is None:
+                self.release_session(sid, "death")
+                m = self.pin_session(sid, model,
+                                     exclude=frozenset(bad_decodes))
+            try:
+                pre = self._request(
+                    model, "torrent_prefill",
+                    {"prompt": prompt, "max_new_tokens": max_new,
+                     "seq_id": sid, "decode_endpoint": m.endpoint,
+                     "deadline_ms": deadline_ms},
+                    role="prefill")
+                # end-to-end disaggregated TTFT: route + prefill + KV
+                # stream — the first token exists (on the decode
+                # replica) the moment the stream commits
+                self._m_tg_ttft.observe(
+                    (time.perf_counter() - t0) * 1e6, model=model)
+                value = self._call_member(
+                    m, model, "torrent_collect",
+                    {"seq_id": sid, "deadline_ms": deadline_ms})
+            except (ConnectionError, EOFError, OSError,
+                    KVTransferError) as e:
+                # the pinned DECODE replica is unreachable (directly on
+                # collect, or via the prefill's stream): exclude it,
+                # drop the pin, re-prefill elsewhere
+                self._fail_over_decode(model, sid, m, bad_decodes, e)
+                if attempt > self.retry.max_attempts:
+                    self._m_tg.inc(model=model, outcome="exhausted")
+                    raise KVTransferError(
+                        f"session {sid!r}: no decode replica survived "
+                        f"{attempt} attempts") from e
+                continue
+            except ServeError as e:
+                if getattr(e, "retriable", False) and \
+                        not isinstance(e, DeadlineExceededError):
+                    # decode-side backpressure (admission full on the
+                    # pinned replica): re-pin onto another decode
+                    self._fail_over_decode(model, sid, m, bad_decodes, e)
+                    if attempt > self.retry.max_attempts:
+                        self._m_tg.inc(model=model, outcome="exhausted")
+                        raise
+                    continue
+                self.release_session(sid, "error")
+                self._m_tg.inc(model=model, outcome="terminal_error")
+                raise
+            self.release_session(
+                sid, str(value.get("finish_reason", "eos")))
+            self._m_tg.inc(model=model, outcome="ok")
+            dt_us = (time.perf_counter() - t0) * 1e6
+            with self._lock:
+                self._completion_seq += 1
+                seq = self._completion_seq
+            return FleetResult(
+                outs={"prefill": pre.outs,
+                      "finish_reason": value.get("finish_reason"),
+                      "ttft_us": value.get("ttft_us")},
+                tokens=value.get("tokens"),
+                version=value.get("version"),
+                version_key=value.get("version_key"),
+                replica_id=value.get("replica_id", m.replica_id),
+                latency_us=dt_us, seq=seq)
+
+    def _fail_over_decode(self, model: str, sid: str, m: _Member,
+                          bad_decodes: set, err: BaseException):
+        """Shared decode-failover bookkeeping for generate_torrent."""
+        bad_decodes.add(m.replica_id)
+        with self._lock:
+            m.suspect = True   # a fresh poll must clear it
+        self.release_session(sid, "death")
+        self._m_tg_failovers.inc(model=model, frm=m.replica_id)
+        logger.warning(
+            "fleet-torrent: decode %s lost session %s (%r) — "
+            "re-prefilling elsewhere", m.replica_id, sid, err)
+
+    def cancel_torrent(self, seq_id: str) -> bool:
+        """Cancel a disaggregated session: release the pin and
+        best-effort drop any staged/finished KV on the pinned replica.
+        Returns whether a pin existed."""
+        with self._lock:
+            pin = self._affinity.get(seq_id)
+            m = self._members.get(pin[0]) if pin else None
+        had = self.release_session(seq_id, "cancel")
+        if m is not None:
+            try:
+                self._call_member(m, pin[1], "torrent_cancel",
+                                  {"seq_id": seq_id}, deadline_s=5.0)
+            except Exception:
+                pass   # the pin is gone either way
+        return had
 
     # -- coordinated hot swap ---------------------------------------------
 

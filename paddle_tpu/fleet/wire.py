@@ -42,7 +42,8 @@ SERVE_ERRORS: Dict[str, type] = {
               serve_errors.ModelUnavailableError,
               serve_errors.BadRequestError, serve_errors.QueueFullError,
               serve_errors.DeadlineExceededError,
-              serve_errors.CacheExhaustedError)
+              serve_errors.CacheExhaustedError,
+              serve_errors.KVTransferError)
 }
 
 

@@ -294,3 +294,278 @@ def _gather_last_token(ctx, X, SeqLens):
     idx = jnp.clip(SeqLens.astype(jnp.int32) - 1, 0, X.shape[1] - 1)
     return {"Out": jnp.take_along_axis(
         X, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]}
+
+
+# ---------------------------------------------------------------------------
+# fluid-torrent: int8-quantized KV residency (per-BLOCK abs-max scale)
+# ---------------------------------------------------------------------------
+# The cache arrays become int8 [NB, BS, H, Dh] with one float32 scale
+# per block ([NB], separate K and V scales): value = int8 * scale[block].
+# Same symmetric +-127 bins as the wire codec (EQuARX idiom), but the
+# quantization GROUP is the residency unit — a block — so a block's
+# scale travels with it over the wire and a decode replica can admit a
+# streamed block without requantizing.
+#
+# Invariants:
+# - prefill OWNS its blocks: the write SETS each written block's scale
+#   to its group abs-max/127 (a recycled block's stale scale is
+#   overwritten, never consulted);
+# - decode append GROWS a block: the first token written into a block
+#   sets its scale fresh; a later token may RAISE it (never lower —
+#   already-quantized neighbors would lose range), in which case the
+#   block's resident int8 values are requantized by old/new and the
+#   event is counted (RequantCountOut — the serve engine meters it as
+#   serve_kv_requant_events_total; frequent requants mean the rounding
+#   error budget is being spent, see docs/TORRENT.md);
+# - attention DEQUANTIZES at the gather: Q and the in-flight K/V stay
+#   float32 (prefill's own attention runs on the exact fp K/V — only
+#   RESIDENCY is quantized), so the first generated token is exact and
+#   quantization error enters through decode-step history reads only.
+
+_Q8_BINS = 127.0
+
+
+def _q8_append_one(cache, scale, new, block_tables, seq_lens):
+    """Append one token's values per slot into an int8 cache.
+    `new`: [S, H, Dh] float32. Returns (cache, scale, n_requant)."""
+    bs = cache.shape[1]
+    pos = jnp.maximum(seq_lens - 1, 0)
+    blk = jnp.take_along_axis(block_tables, (pos // bs)[:, None],
+                              axis=1)[:, 0]
+    active = seq_lens > 0
+    blk = jnp.where(active, blk, 0)
+    off = jnp.where(active, pos % bs, 0)
+    first = (pos % bs) == 0            # first token written into the block
+    tok = new.astype(jnp.float32)
+    needed = jnp.max(jnp.abs(tok), axis=(1, 2)) / _Q8_BINS        # [S]
+    old = scale[blk]                                              # [S]
+    base = jnp.where(first, jnp.float32(0.0), old)
+    s_new = jnp.maximum(base, needed)
+    requant = active & (~first) & (needed > old)
+    # requantize the whole resident block where its scale grew; ratio 1
+    # elsewhere makes the rewrite an exact identity (and the conflicting
+    # inactive-slot writes all target trash block 0 with ratio 1)
+    ratio = jnp.where(requant, old / jnp.maximum(s_new, 1e-30),
+                      jnp.float32(1.0))
+    adj = jnp.rint(cache[blk].astype(jnp.float32)
+                   * ratio[:, None, None, None])
+    cache = cache.at[blk].set(adj.astype(cache.dtype))
+    safe = jnp.where(s_new > 0, s_new, jnp.float32(1.0))
+    q = jnp.rint(jnp.clip(tok / safe[:, None, None], -_Q8_BINS, _Q8_BINS))
+    cache = cache.at[blk, off].set(q.astype(cache.dtype))
+    scale = scale.at[blk].set(jnp.where(active, s_new, old))
+    return cache, scale, jnp.sum(requant.astype(jnp.int32))
+
+
+def _q8_prefill_write_one(cache, scale, x, block_tables, seq_lens):
+    """Scatter a padded prompt's values ([B, T, H, Dh]) into an int8
+    cache, setting each written block's scale to its group abs-max."""
+    bs = cache.shape[1]
+    B, T = x.shape[0], x.shape[1]
+    n_ord = -(-T // bs)
+    t = jnp.arange(T)
+    valid = t[None, :] < seq_lens[:, None]                        # [B, T]
+    blk = jnp.take_along_axis(
+        block_tables, jnp.broadcast_to((t // bs)[None, :], (B, T)), axis=1)
+    blk = jnp.where(valid, blk, 0)
+    off = jnp.broadcast_to((t % bs)[None, :], (B, T))
+    xm = jnp.where(valid[:, :, None, None], x.astype(jnp.float32), 0.0)
+    pad = n_ord * bs - T
+    xp = jnp.pad(xm, ((0, 0), (0, pad), (0, 0), (0, 0))) if pad else xm
+    grp = xp.reshape(B, n_ord, bs, x.shape[2], x.shape[3])
+    needed = jnp.max(jnp.abs(grp), axis=(2, 3, 4)) / _Q8_BINS  # [B, n_ord]
+    safe = jnp.where(needed > 0, needed, jnp.float32(1.0))
+    per_pos = jnp.repeat(safe, bs, axis=1)[:, :T]                 # [B, T]
+    q = jnp.rint(jnp.clip(xm / per_pos[:, :, None, None],
+                          -_Q8_BINS, _Q8_BINS))
+    cache = cache.at[blk.reshape(-1), off.reshape(-1)].set(
+        q.reshape((B * T,) + x.shape[2:]).astype(cache.dtype))
+    # overwrite the scale of every block that received a valid position
+    # (prefill owns the block); rows/ordinals past seq_len redirect to
+    # trash block 0 where they rewrite its existing scale
+    has = (jnp.arange(n_ord)[None, :] * bs) < seq_lens[:, None]
+    blk_sc = jnp.where(has, block_tables[:, :n_ord], 0)
+    scale = scale.at[blk_sc.reshape(-1)].set(
+        jnp.where(has, needed, scale[blk_sc]).reshape(-1))
+    return cache, scale
+
+
+def paged_attention_q8_reference(q, k_cache, v_cache, k_scale, v_scale,
+                                 block_tables, seq_lens, sm_scale):
+    """Reference math of the quantized decode read: gather int8 blocks
+    through the table, dequantize by per-block scale, then the same
+    masked softmax as paged_attention_reference."""
+    S, H, Dh = q.shape
+    nb, bs = k_cache.shape[0], k_cache.shape[1]
+    T = block_tables.shape[1] * bs
+    flat = (block_tables[:, :, None] * bs
+            + jnp.arange(bs)[None, None, :]).reshape(S, T)
+    ks = jnp.repeat(k_scale[block_tables], bs, axis=1)            # [S, T]
+    vs = jnp.repeat(v_scale[block_tables], bs, axis=1)
+    k = jnp.take(k_cache.reshape(nb * bs, H, Dh), flat,
+                 axis=0).astype(jnp.float32) * ks[:, :, None, None]
+    v = jnp.take(v_cache.reshape(nb * bs, H, Dh), flat,
+                 axis=0).astype(jnp.float32) * vs[:, :, None, None]
+    s = jnp.einsum("shd,sthd->sht", q.astype(jnp.float32), k) * sm_scale
+    mask = jnp.arange(T)[None, :] < seq_lens[:, None]
+    s = jnp.where(mask[:, None, :], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("sht,sthd->shd", p, v) \
+        / jnp.maximum(l, 1e-20)[..., 0][..., None]
+    o = jnp.where((seq_lens > 0)[:, None, None], o, 0.0)
+    return o.astype(q.dtype)
+
+
+def _paged_decode_kernel_q8(seq_lens_ref, bt_ref, ks_ref, vs_ref, q_ref,
+                            k_ref, v_ref, o_ref, m_sc, l_sc, acc_sc, *,
+                            sm_scale, block_size):
+    """The _paged_decode_kernel with two more scalar-prefetch operands:
+    the per-block K/V scales ride SMEM next to the block table, and the
+    streamed int8 tile dequantizes in VMEM right after the load — the
+    grid, index maps and online-softmax carry are unchanged."""
+    from jax.experimental import pallas as pl
+
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    seq_len = seq_lens_ref[s]
+    live = j * block_size < seq_len
+
+    @pl.when(live)
+    def _update():
+        blk = bt_ref[s, j]
+        q = q_ref[0]                                    # [H, Dh]
+        k = k_ref[0].astype(jnp.float32) * ks_ref[blk]  # [BS, H, Dh]
+        v = v_ref[0].astype(jnp.float32) * vs_ref[blk]
+        scores = jnp.einsum(
+            "hd,bhd->hb", q.astype(jnp.float32), k,
+            preferred_element_type=jnp.float32) * sm_scale
+        pos = j * block_size + lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        scores = jnp.where(pos < seq_len, scores, NEG_INF)
+        m = m_sc[...]
+        m_new = jnp.maximum(m, jnp.max(scores, axis=1))
+        p = jnp.exp(scores - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_sc[...] = l_sc[...] * alpha + jnp.sum(p, axis=1)
+        acc_sc[...] = acc_sc[...] * alpha[:, None] + jnp.einsum(
+            "hb,bhd->hd", p, v, preferred_element_type=jnp.float32)
+        m_sc[...] = m_new
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        l = jnp.maximum(l_sc[...], 1e-20)
+        o_ref[0] = (acc_sc[...] / l[:, None]).astype(o_ref.dtype)
+
+
+def _paged_attention_q8_pallas(q, k_cache, v_cache, k_scale, v_scale,
+                               block_tables, seq_lens, sm_scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S, H, Dh = q.shape
+    bs = k_cache.shape[1]
+    max_b = block_tables.shape[1]
+    kernel = functools.partial(_paged_decode_kernel_q8, sm_scale=sm_scale,
+                               block_size=bs)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(S, max_b),
+        in_specs=[
+            pl.BlockSpec((1, H, Dh), lambda s, j, sl, bt, ks, vs: (s, 0, 0)),
+            pl.BlockSpec((1, bs, H, Dh),
+                         lambda s, j, sl, bt, ks, vs: (bt[s, j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, H, Dh),
+                         lambda s, j, sl, bt, ks, vs: (bt[s, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, Dh),
+                               lambda s, j, sl, bt, ks, vs: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H,), jnp.float32),
+            pltpu.VMEM((H,), jnp.float32),
+            pltpu.VMEM((H, Dh), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, Dh), q.dtype),
+        interpret=_interpret(),
+    )(seq_lens.astype(jnp.int32), block_tables.astype(jnp.int32),
+      k_scale.astype(jnp.float32), v_scale.astype(jnp.float32),
+      q, k_cache, v_cache)
+
+
+def paged_attention_q8(q, k_cache, v_cache, k_scale, v_scale, block_tables,
+                       seq_lens, sm_scale=None):
+    """Quantized-residency decode read: kernel on TPU / under the
+    interpreter, dequantizing reference math elsewhere."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if _pallas_ok():
+        return _paged_attention_q8_pallas(q, k_cache, v_cache, k_scale,
+                                          v_scale, block_tables, seq_lens,
+                                          sm_scale)
+    return paged_attention_q8_reference(q, k_cache, v_cache, k_scale,
+                                        v_scale, block_tables, seq_lens,
+                                        sm_scale)
+
+
+@register_op("paged_attention_q8", propagate_seqlen=False)
+def _paged_attention_q8_op(ctx, Q, K, V, KCache, VCache, KScale, VScale,
+                           RequantCount, BlockTables, SeqLens):
+    """One decode step over int8 caches. Same contract as
+    paged_attention plus per-block scale vars ([num_blocks] f32, updated
+    in place alongside their cache) and a [1] int32 requant-event
+    counter the serve engine meters."""
+    H = int(ctx.attr("num_heads", 1))
+    S, D = Q.shape
+    Dh = D // H
+    sm_scale = float(ctx.attr("sm_scale", 1.0 / math.sqrt(Dh)))
+    seq = SeqLens.astype(jnp.int32)
+    bt = BlockTables.astype(jnp.int32)
+    kc, ks, n_k = _q8_append_one(KCache, KScale, K.reshape(S, H, Dh),
+                                 bt, seq)
+    vc, vs, n_v = _q8_append_one(VCache, VScale, V.reshape(S, H, Dh),
+                                 bt, seq)
+    out = paged_attention_q8(Q.reshape(S, H, Dh), kc, vc, ks, vs, bt, seq,
+                             sm_scale)
+    return {"Out": out.reshape(S, D), "KCacheOut": kc, "VCacheOut": vc,
+            "KScaleOut": ks, "VScaleOut": vs,
+            "RequantCountOut": RequantCount + (n_k + n_v)}
+
+
+@register_op("prefill_attention_q8", propagate_seqlen=False)
+def _prefill_attention_q8_op(ctx, Q, K, V, KCache, VCache, KScale, VScale,
+                             BlockTables, SeqLens):
+    """Prompt phase over int8 caches: attention runs on the exact fp
+    K/V in flight (prefill logits — and therefore the first token — are
+    bit-identical to the fp cache), quantization happens only at the
+    residency write. No requant counter: prefill always owns the blocks
+    it writes."""
+    H = int(ctx.attr("num_heads", 1))
+    B, T, D = Q.shape
+    Dh = D // H
+    sm_scale = float(ctx.attr("sm_scale", 1.0 / math.sqrt(Dh)))
+    seq = SeqLens.astype(jnp.int32)
+    bt = BlockTables.astype(jnp.int32)
+    k4 = K.reshape(B, T, H, Dh)
+    v4 = V.reshape(B, T, H, Dh)
+    out = flash_attention(
+        Q.reshape(B, T, H, Dh).transpose(0, 2, 1, 3),
+        k4.transpose(0, 2, 1, 3), v4.transpose(0, 2, 1, 3),
+        jnp.int32(0), True, sm_scale, 0.0)
+    kc, ks = _q8_prefill_write_one(KCache, KScale, k4, bt, seq)
+    vc, vs = _q8_prefill_write_one(VCache, VScale, v4, bt, seq)
+    return {"Out": out.transpose(0, 2, 1, 3).reshape(B, T, D),
+            "KCacheOut": kc, "VCacheOut": vc,
+            "KScaleOut": ks, "VScaleOut": vs}

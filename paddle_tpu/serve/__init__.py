@@ -31,9 +31,11 @@ from .bucketing import (DEFAULT_ROWS_LADDER, BucketLadder,  # noqa: F401
 from .decode import (DecodeEngine, GenerationResult,  # noqa: F401
                      GenerationStream)
 from .errors import (BadRequestError, CacheExhaustedError,  # noqa: F401
-                     DeadlineExceededError, ModelNotFoundError,
-                     ModelUnavailableError, QueueFullError, ServeError)
-from .kvcache import PagedKVCache  # noqa: F401
+                     DeadlineExceededError, KVTransferError,
+                     ModelNotFoundError, ModelUnavailableError,
+                     QueueFullError, ServeError)
+from .kvcache import (PagedKVCache, block_residency_nbytes,  # noqa: F401
+                      blocks_for_budget)
 from .registry import (DecodeModel, ModelRegistry,  # noqa: F401
                        ModelVersion, read_decode_signature,
                        read_model_manifest)

@@ -61,15 +61,18 @@ class GenerationResult:
     """What a finished generation resolves to."""
 
     __slots__ = ("tokens", "prompt_len", "finish_reason", "ttft_us",
-                 "version_id")
+                 "version_id", "kv")
 
     def __init__(self, tokens, prompt_len, finish_reason, ttft_us,
-                 version_id):
+                 version_id, kv=None):
         self.tokens = tokens              # generated tokens (no prompt)
         self.prompt_len = prompt_len
-        self.finish_reason = finish_reason  # "eos" | "length"
+        self.finish_reason = finish_reason  # "eos" | "length" | "prefill"
         self.ttft_us = ttft_us
         self.version_id = version_id
+        # prefill_only submits resolve with the prompt's extracted KV
+        # blocks here (fluid-torrent streams them to a decode replica)
+        self.kv = kv
 
     def __repr__(self):
         return (f"GenerationResult({len(self.tokens)} tokens, "
@@ -100,10 +103,12 @@ class GenerationStream:
 
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "future", "stream", "deadline",
-                 "t_enq", "ctx", "ts_wall", "resolved")
+                 "t_enq", "ctx", "ts_wall", "resolved", "prefill_only",
+                 "premat", "first_token")
 
     def __init__(self, prompt, max_new, future, stream, deadline, ctx,
-                 ts_wall):
+                 ts_wall, prefill_only=False, premat=None,
+                 first_token=None):
         self.prompt = prompt
         self.max_new = max_new
         self.future = future
@@ -113,6 +118,13 @@ class _GenRequest:
         self.ctx = ctx
         self.ts_wall = ts_wall
         self.resolved = False             # guarded by the engine cond
+        # fluid-torrent disaggregation: prefill_only stops after the
+        # first token and resolves with the extracted KV payload; premat
+        # is the inverse — a KV payload prefilled elsewhere, injected at
+        # admission with `first_token` seeding the first decode step
+        self.prefill_only = prefill_only
+        self.premat = premat
+        self.first_token = first_token
 
 
 class _Slot:
@@ -137,9 +149,19 @@ class DecodeEngine:
     """One generative model's slots + decode thread."""
 
     def __init__(self, registry, name: str, max_queue: int = 256,
-                 admission: str = "continuous"):
+                 admission: str = "continuous",
+                 simulate_prefill_us_per_token: float = 0.0,
+                 simulate_decode_step_us: float = 0.0):
         self._registry = registry
         self._name = name
+        # rehearsal-rig knobs (tools/bench honesty posture): model the
+        # compute-bound prefill (us per PADDED token of the chunk) and
+        # memory-bound decode (us per fixed-slot STEP — the whole-cache
+        # read every step pays regardless of live lanes) so topology
+        # effects show on the CPU test backend
+        self._sim_prefill_us = float(simulate_prefill_us_per_token)
+        self._sim_decode_us = float(simulate_decode_step_us)
+        self._requant_seen = 0            # engine thread only
         sig = registry.get(name).decode.signature
         self._sched = SlotScheduler(sig["max_slots"], max_queue=max_queue,
                                     admission=admission)
@@ -161,6 +183,9 @@ class DecodeEngine:
             "serve_decode_step_us", "decode step wall time")
         self._m_prefill_latency = _metrics.histogram(
             "serve_prefill_us", "prefill step wall time")
+        self._m_requant = _metrics.counter(
+            "serve_kv_requant_events_total",
+            "int8 KV whole-block requantize events, per model")
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name=f"serve-decode-{name}")
         self._thread.start()
@@ -170,11 +195,62 @@ class DecodeEngine:
     def submit(self, prompt: Sequence[int],
                max_new_tokens: int = 16,
                deadline_ms: Optional[float] = None,
-               stream: bool = False):
+               stream: bool = False, prefill_only: bool = False):
         """Enqueue one generation. Returns its Future (stream=False) or a
         GenerationStream (stream=True). Rejections are immediate:
         QueueFullError / CacheExhaustedError are retriable backpressure,
-        BadRequestError means the prompt can never run."""
+        BadRequestError means the prompt can never run.
+
+        `prefill_only=True` is fluid-torrent's prefill half: run the
+        prompt's prefill step, resolve the Future with a
+        GenerationResult carrying the first token AND the prompt's
+        extracted KV payload (`result.kv`), and vacate immediately — the
+        generation continues on whichever replica `submit_prefilled`
+        injects the payload into."""
+        ver = self._registry.get(self._name)
+        if ver.decode is None:
+            raise BadRequestError(
+                f"model {self._name!r} has no decode program — "
+                f"a one-shot model cannot generate")
+        sig = ver.decode.signature
+        if prefill_only and stream:
+            raise BadRequestError(
+                "prefill_only produces one token — streaming does not "
+                "apply")
+        prompt = [int(t) for t in prompt]
+        self._validate_prompt(prompt, sig)
+        max_new = int(max_new_tokens)
+        if not prefill_only:
+            if max_new < 1:
+                raise BadRequestError("max_new_tokens must be >= 1")
+            if len(prompt) + max_new > sig["max_context"]:
+                raise BadRequestError(
+                    f"prompt {len(prompt)} + max_new_tokens {max_new} "
+                    f"exceeds max_context {sig['max_context']}")
+        ctx = _xray.child_of() if _flags.get_flag("observe") else None
+        ts_wall = time.time() if ctx is not None else 0.0
+        fut: Future = Future()
+        gstream = GenerationStream(fut) if stream else None
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        req = _GenRequest(prompt, max_new, fut, gstream, deadline, ctx,
+                          ts_wall, prefill_only=prefill_only)
+        self._enqueue(req)
+        return gstream if stream else fut
+
+    def submit_prefilled(self, prompt: Sequence[int], first_token: int,
+                         kv: dict, max_new_tokens: int = 16,
+                         deadline_ms: Optional[float] = None,
+                         stream: bool = False):
+        """Admit a generation whose prefill ran ELSEWHERE (fluid-torrent
+        disaggregation): `kv` is the payload a `prefill_only` submit
+        resolved with — the prompt's cache-block rows (plus int8
+        per-block scales when the residency is quantized). The engine
+        copies those rows into this replica's cache arrays at its own
+        block ids and enters decode directly; `first_token` (the remote
+        prefill's argmax) counts as generated token #1 exactly like the
+        local prefill path, so `max_new_tokens` means the same thing in
+        both modes."""
         ver = self._registry.get(self._name)
         if ver.decode is None:
             raise BadRequestError(
@@ -182,6 +258,51 @@ class DecodeEngine:
                 f"a one-shot model cannot generate")
         sig = ver.decode.signature
         prompt = [int(t) for t in prompt]
+        self._validate_prompt(prompt, sig)
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise BadRequestError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new > sig["max_context"]:
+            raise BadRequestError(
+                f"prompt {len(prompt)} + max_new_tokens {max_new} "
+                f"exceeds max_context {sig['max_context']}")
+        first_token = int(first_token)
+        if first_token < 0 or first_token >= sig["vocab"]:
+            raise BadRequestError(
+                f"first_token out of range for vocab {sig['vocab']}")
+        if not isinstance(kv, dict) or not isinstance(kv.get("cache"),
+                                                      dict):
+            raise BadRequestError(
+                "kv payload must be a dict with a 'cache' mapping "
+                "(cache var -> [n_blocks, ...] rows)")
+        if str(kv.get("kv_dtype", "fp32")) != \
+                str(sig.get("kv_dtype", "fp32")):
+            raise BadRequestError(
+                f"kv payload residency {kv.get('kv_dtype')!r} does not "
+                f"match this model's {sig.get('kv_dtype', 'fp32')!r}")
+        need = -(-len(prompt) // sig["block_size"])
+        for cname in sig["cache_vars"]:
+            rows = kv["cache"].get(cname)
+            if rows is None or len(rows) < need:
+                raise BadRequestError(
+                    f"kv payload is missing block rows for {cname!r} "
+                    f"({need} needed)")
+        if sig.get("scale_vars") and not isinstance(kv.get("scales"),
+                                                    dict):
+            raise BadRequestError(
+                "int8 kv payload must carry per-block 'scales'")
+        ctx = _xray.child_of() if _flags.get_flag("observe") else None
+        ts_wall = time.time() if ctx is not None else 0.0
+        fut: Future = Future()
+        gstream = GenerationStream(fut) if stream else None
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        req = _GenRequest(prompt, max_new, fut, gstream, deadline, ctx,
+                          ts_wall, premat=kv, first_token=first_token)
+        self._enqueue(req)
+        return gstream if stream else fut
+
+    def _validate_prompt(self, prompt, sig):
         if not prompt:
             raise BadRequestError("empty prompt")
         if any(t < 0 or t >= sig["vocab"] for t in prompt):
@@ -192,21 +313,8 @@ class DecodeEngine:
             raise BadRequestError(
                 f"prompt of {len(prompt)} tokens exceeds the largest "
                 f"prefill rung {max_rung}")
-        max_new = int(max_new_tokens)
-        if max_new < 1:
-            raise BadRequestError("max_new_tokens must be >= 1")
-        if len(prompt) + max_new > sig["max_context"]:
-            raise BadRequestError(
-                f"prompt {len(prompt)} + max_new_tokens {max_new} "
-                f"exceeds max_context {sig['max_context']}")
-        ctx = _xray.child_of() if _flags.get_flag("observe") else None
-        ts_wall = time.time() if ctx is not None else 0.0
-        fut: Future = Future()
-        gstream = GenerationStream(fut) if stream else None
-        deadline = (time.monotonic() + deadline_ms / 1e3
-                    if deadline_ms is not None else None)
-        req = _GenRequest(prompt, max_new, fut, gstream, deadline, ctx,
-                          ts_wall)
+
+    def _enqueue(self, req: _GenRequest):
         with self._cond:
             if self._closed:
                 raise ModelUnavailableError(
@@ -221,7 +329,6 @@ class DecodeEngine:
                     f"{len(self._sched.pending)} generations queued "
                     f"(max_queue={self._sched.max_queue}) — retry with "
                     f"backoff") from None
-        return gstream if stream else fut
 
     def generate(self, prompt, max_new_tokens: int = 16,
                  deadline_ms: Optional[float] = None) -> GenerationResult:
@@ -347,6 +454,7 @@ class DecodeEngine:
             return
         if self._ver is None:
             self._ver = self._registry.acquire(self._name)
+            self._requant_seen = 0        # fresh version, fresh counter
             with self._cond:
                 if self._sched.n_slots != \
                         self._ver.decode.signature["max_slots"]:
@@ -385,7 +493,9 @@ class DecodeEngine:
                 if not self._sched.pending:
                     break
                 req = self._sched.pending[0]
-                total = len(req.prompt) + req.max_new
+                # prefill_only never decodes: reserve just the prompt
+                total = len(req.prompt) + \
+                    (0 if req.prefill_only else req.max_new)
                 try:
                     dec.kvcache.reserve(slot, total)
                 except CacheExhaustedError as e:
@@ -404,10 +514,20 @@ class DecodeEngine:
                              exc=rejected[1])
         if not admitted:
             return
+        # injected (premat) admissions skip prefill entirely: copy the
+        # wire-delivered KV rows into the cache and go straight to decode
+        fresh = []
+        for slot, state in admitted:
+            if state.req.premat is not None:
+                self._inject_premat(dec, sig, slot, state)
+            else:
+                fresh.append((slot, state))
+        if not fresh:
+            return
         # group by prompt-length rung; each group is one prefill step
         ladder = self._ver.ladder
         groups: Dict[int, List] = {}
-        for slot, state in admitted:
+        for slot, state in fresh:
             rung = ladder.dim_rung("tokens", 1, len(state.req.prompt))
             groups.setdefault(rung, []).append((slot, state))
         for rung, members in groups.items():
@@ -429,6 +549,12 @@ class DecodeEngine:
         t0 = time.perf_counter()
         logits, = self._ver.prepared.run({
             "tokens": tokens, "block_tables": bt, "seq_lens": seq_lens})
+        if self._sim_prefill_us > 0.0:
+            # compute-bound phase: cost scales with the chunk's padded
+            # token area (the engine thread IS the chip analog, so this
+            # stall delays everything behind it — the interference the
+            # torrent bench measures)
+            time.sleep(self._sim_prefill_us * rows * rung / 1e6)
         self._m_prefill_latency.observe(
             (time.perf_counter() - t0) * 1e6, model=self._name)
         # a warm=False generative version becomes "warmed" by serving
@@ -442,6 +568,19 @@ class DecodeEngine:
             state.ttft_us = (done - state.req.t_enq) * 1e6
             self._m_ttft.observe(state.ttft_us, model=self._name)
             self._m_tokens.inc(model=self._name)
+            if state.req.prefill_only:
+                # fluid-torrent prefill half: hand the prompt's KV rows
+                # (still allocated this instant) to the caller, then
+                # vacate — a decode replica owns the rest
+                kv = self._extract_kv(dec, sig, slot,
+                                      len(state.req.prompt))
+                self._vacate(slot)
+                self._finish_req(state.req, "ok",
+                                 result=GenerationResult(
+                                     [tok], len(state.req.prompt),
+                                     "prefill", state.ttft_us,
+                                     self._ver.version_id, kv=kv))
+                continue
             state.ctx_len = len(state.req.prompt)
             state.last_token = tok
             state.generated = [tok]
@@ -449,6 +588,83 @@ class DecodeEngine:
             if state.req.stream is not None:
                 state.req.stream._push(tok)
             self._maybe_finish(slot, state, tok, sig)
+
+    # -- fluid-torrent KV extraction / injection ---------------------------
+
+    def _extract_kv(self, dec, sig, slot: int, prompt_len: int) -> dict:
+        """Copy the slot's resident KV block rows (plus int8 per-block
+        scales) out of the bound version's scope. Rows are position-
+        ordered, so they can be written at ANY replica's block ids — the
+        block table is the only indirection. Runs on the engine thread
+        between steps, so the arrays are quiescent."""
+        ids = dec.kvcache.slot_blocks(slot)
+        scope = self._ver.scope
+        cache = {}
+        for cname in sig["cache_vars"]:
+            arr = np.asarray(scope.find_var(cname))
+            cache[cname] = np.array(arr[ids])
+        out = {"cache": cache, "prompt_len": int(prompt_len),
+               "n_blocks": len(ids),
+               "kv_dtype": str(sig.get("kv_dtype", "fp32"))}
+        smap = sig.get("scale_vars") or {}
+        if smap:
+            out["scales"] = {
+                c: np.array(np.asarray(scope.find_var(s))[ids])
+                for c, s in smap.items()}
+        return out
+
+    def _inject_premat(self, dec, sig, slot: int, state: _Slot):
+        """Write a wire-delivered KV payload into this replica's cache
+        at the slot's freshly allocated block ids and seed decode state
+        — the injected sequence's next step is an ordinary decode append
+        at position prompt_len. Engine thread only (scope.set_var bumps
+        the version so the next step re-gathers; no recompile)."""
+        req = state.req
+        n = len(req.prompt)
+        dec.kvcache.ensure(slot, n)
+        ids = dec.kvcache.slot_blocks(slot)
+        scope = self._ver.scope
+        smap = sig.get("scale_vars") or {}
+        scales = req.premat.get("scales") or {}
+        for cname in sig["cache_vars"]:
+            base = np.array(np.asarray(scope.find_var(cname)))
+            rows = np.asarray(req.premat["cache"][cname])
+            base[ids] = rows[:len(ids)].astype(base.dtype)
+            scope.set_var(cname, base)
+            sname = smap.get(cname)
+            if sname is not None and cname in scales:
+                sb = np.array(np.asarray(scope.find_var(sname)))
+                sb[ids] = np.asarray(scales[cname],
+                                     np.float32)[:len(ids)]
+                scope.set_var(sname, sb)
+        tok = int(req.first_token)
+        # local TTFT covers admit+copy only; the end-to-end (wire
+        # included) TTFT is metered at the torrent layer
+        state.ttft_us = (time.monotonic() - req.t_enq) * 1e6
+        self._m_ttft.observe(state.ttft_us, model=self._name)
+        state.ctx_len = n
+        state.last_token = tok
+        state.generated = [tok]
+        state.started = True
+        if req.stream is not None:
+            req.stream._push(tok)
+        self._maybe_finish(slot, state, tok, sig)
+
+    def _sample_requant(self, sig):
+        """Meter int8 whole-block requantize events: the jitted decode
+        step increments the [1] int32 requant var; the engine publishes
+        the delta. Engine thread only."""
+        rq = sig.get("requant_var")
+        if rq is None:
+            return
+        try:
+            val = int(np.asarray(self._ver.scope.find_var(rq))[0])
+        except Exception:                 # noqa: BLE001
+            return
+        if val > self._requant_seen:
+            self._m_requant.inc(val - self._requant_seen,
+                                model=self._name)
+        self._requant_seen = val
 
     # -- decode ------------------------------------------------------------
 
@@ -474,10 +690,16 @@ class DecodeEngine:
             "tokens": tokens,
             "block_tables": dec.kvcache.block_tables,
             "seq_lens": seq_lens})
+        if self._sim_decode_us > 0.0:
+            # memory-bound phase: a fixed-slot step pays (roughly) the
+            # whole-cache read however many lanes are live — per-STEP
+            # cost, which is the batching dividend disaggregation keeps
+            time.sleep(self._sim_decode_us / 1e6)
         self._m_step_latency.observe(
             (time.perf_counter() - t0) * 1e6, model=self._name)
         self._m_steps.inc(model=self._name)
         self._m_occupancy.observe(len(live), model=self._name)
+        self._sample_requant(sig)
         now = time.monotonic()
         for i, s in live:
             s.ctx_len += 1

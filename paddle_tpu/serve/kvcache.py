@@ -43,6 +43,29 @@ from ..observe import metrics as _metrics
 from .errors import CacheExhaustedError
 
 
+def block_residency_nbytes(sig: dict) -> int:
+    """Device bytes one cache block costs across every cache var of a
+    decode signature — the unit the capacity planner divides a byte
+    budget by. fluid-torrent int8 residency pays 1 byte per position
+    plus one float32 per-block scale per cache var, vs 4 bytes per
+    position for fp32: at the tiny LM's (block_size 4, 2 heads, head_dim
+    8) geometry that is 68 vs 256 bytes — ~3.8x more blocks (and
+    therefore concurrent sequences) per chip at a fixed budget."""
+    per_pos = int(sig["block_size"]) * int(sig["num_heads"]) \
+        * int(sig["head_dim"])
+    n_caches = len(sig["cache_vars"])
+    if sig.get("kv_dtype") == "int8":
+        return n_caches * (per_pos + 4)    # int8 values + f32 block scale
+    return n_caches * per_pos * 4
+
+
+def blocks_for_budget(sig: dict, budget_bytes: int) -> int:
+    """Allocatable blocks (excluding the trash block) a device byte
+    budget affords under `sig`'s residency layout."""
+    per_block = block_residency_nbytes(sig)
+    return max(int(budget_bytes) // per_block - 1, 0)
+
+
 class PagedKVCache:
     """Host-side allocator for one model version's paged KV cache."""
 
@@ -157,6 +180,14 @@ class PagedKVCache:
                 blocks.append(b)
             self._publish_locked()
             return self.block_tables
+
+    def slot_blocks(self, slot: int) -> List[int]:
+        """Snapshot of the physical blocks allocated to `slot`, in
+        position order — fluid-torrent reads these rows out of the cache
+        arrays when extracting a prefilled sequence's KV (and writes a
+        wire-delivered payload at them on injection)."""
+        with self._lock:
+            return list(self._slot_blocks[slot])
 
     def free_slot(self, slot: int):
         """Return the slot's blocks and any unused reservation to the

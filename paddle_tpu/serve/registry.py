@@ -360,11 +360,21 @@ class ModelRegistry:
         if sig is not None:
             # KV cache state is never serialized (io._is_persistable
             # skips the @KV_CACHE suffix): materialize zeros of the
-            # manifest-declared shape BEFORE anything compiles
+            # manifest-declared shape BEFORE anything compiles.
+            # fluid-torrent int8 residency: int8 cache arrays plus their
+            # per-block scale vars and the shared requant counter, all
+            # named by the signature
             shape = (sig["num_blocks"], sig["block_size"],
                      sig["num_heads"], sig["head_dim"])
+            cache_np = np.int8 if sig.get("kv_dtype") == "int8" \
+                else np.float32
             for cname in sig["cache_vars"]:
-                scope.set_var(cname, np.zeros(shape, np.float32))
+                scope.set_var(cname, np.zeros(shape, cache_np))
+            for sname in (sig.get("scale_vars") or {}).values():
+                scope.set_var(sname,
+                              np.zeros((sig["num_blocks"],), np.float32))
+            if sig.get("requant_var"):
+                scope.set_var(sig["requant_var"], np.zeros((1,), np.int32))
         prepared = self._exe.prepare(program, fetch_list=fetch_vars,
                                      scope=scope)
         prepared.telemetry_source = "serving"

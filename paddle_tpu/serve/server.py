@@ -49,6 +49,11 @@ class ServeConfig:
     # "continuous" (finished sequences vacate mid-batch, default) or
     # "drain" (classic drain-and-refill; the bench A/B baseline)
     decode_admission: str = "continuous"
+    # fluid-torrent rehearsal knobs (tools/ fleet processes): model the
+    # compute-bound prefill / memory-bound decode cost split on the CPU
+    # test backend — 0.0 disables (see DecodeEngine)
+    simulate_prefill_us_per_token: float = 0.0
+    simulate_decode_step_us: float = 0.0
     # fluid-pulse opt-in: expose this process's health plane and this
     # server's queue-saturation readiness check on it (0 = ephemeral
     # port; requires the observe flag — start_pulse refuses otherwise)
@@ -160,7 +165,11 @@ class InferenceServer:
                     self.registry, name,
                     max_queue=(max_queue if max_queue is not None
                                else self.config.max_queue),
-                    admission=self.config.decode_admission)
+                    admission=self.config.decode_admission,
+                    simulate_prefill_us_per_token=(
+                        self.config.simulate_prefill_us_per_token),
+                    simulate_decode_step_us=(
+                        self.config.simulate_decode_step_us))
             return ver
         if name not in self._batchers:
             self._batchers[name] = MicroBatcher(
@@ -265,6 +274,30 @@ class InferenceServer:
         return self._engine(name).submit(
             prompt, max_new_tokens=max_new_tokens, deadline_ms=deadline_ms,
             stream=True)
+
+    # -- disaggregated halves (fluid-torrent) ------------------------------
+
+    def submit_prefill(self, name: str, prompt,
+                       deadline_ms: Optional[float] = None) -> Future:
+        """Prefill half: run the prompt's prefill step only. The Future
+        resolves to a GenerationResult whose `kv` carries the extracted
+        KV payload and whose single token seeds the decode half."""
+        if deadline_ms is None:
+            deadline_ms = self.config.default_deadline_ms
+        return self._engine(name).submit(
+            prompt, deadline_ms=deadline_ms, prefill_only=True)
+
+    def submit_prefilled(self, name: str, prompt, first_token: int,
+                         kv: dict, max_new_tokens: int = 16,
+                         deadline_ms: Optional[float] = None) -> Future:
+        """Decode half: inject a KV payload prefilled elsewhere and run
+        the rest of the generation here. Returns the Future of the full
+        GenerationResult (its tokens start with `first_token`)."""
+        if deadline_ms is None:
+            deadline_ms = self.config.default_deadline_ms
+        return self._engine(name).submit_prefilled(
+            prompt, first_token, kv, max_new_tokens=max_new_tokens,
+            deadline_ms=deadline_ms)
 
     def infer(self, name: str, feed: Dict[str, np.ndarray],
               deadline_ms: Optional[float] = None) -> List[np.ndarray]:

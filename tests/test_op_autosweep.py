@@ -578,6 +578,10 @@ WAIVED = {
     "fused_attention": "pallas kernel; tests/test_flash_attention.py",
     "paged_attention": "stateful KV-cache step; tests/test_decode.py",
     "prefill_attention": "stateful KV-cache step; tests/test_decode.py",
+    "paged_attention_q8": "stateful int8-KV step; tests/test_torrent.py "
+                          "parity vs fp32 cache",
+    "prefill_attention_q8": "stateful int8-KV step; tests/test_torrent.py "
+                            "parity vs fp32 cache",
     "gather_last_token": "index gather, inference-only; tests/test_decode.py",
     "auc": "stateful metric accumulators; tests/test_smoke.py metrics",
     "sequence_slice": "padded-slice vs numpy; tests/test_api_breadth.py",

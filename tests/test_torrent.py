@@ -1,0 +1,440 @@
+"""fluid-torrent: disaggregated serving — affinity routing, KV wire
+stream, int8 KV residency, end-to-end prefill/decode split.
+
+Tier-1 coverage for ISSUE 20 (docs/TORRENT.md):
+
+- session-affinity dispatch: pin lifecycle, release on EOS / cancel /
+  replica death, role-filtered picking (prefill pool stays
+  least-loaded, decode-only members never take prefill traffic);
+- the KV wire stream: record round-trip for both residencies,
+  torn-transfer resume from the acked watermark, nonce supersede
+  (re-prefill of the same seq), sender gives up with KVTransferError;
+- int8 KV residency: token-for-token parity vs the fp32 cache on the
+  tiny LM, and the capacity planner's >= 3x concurrent-sequence
+  advantage at a fixed byte budget;
+- end-to-end: a 1-prefill + 2-decode in-process fleet reproduces the
+  solo server's greedy tokens exactly, pins drain to zero, transfer
+  bytes are metered, and the whole generation — prefill half, KV
+  stream hop, decode half — stitches into ONE trace.
+
+Replicas here are IN-PROCESS; the multi-process decode-kill drill is
+tools/chaos_drill.py --scenario decode_kill (slow wrapper at the
+bottom).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import fleet, observe, serve
+from paddle_tpu.models import tiny_lm
+from paddle_tpu.observe import xray
+from paddle_tpu.serve.errors import (KVTransferError,
+                                     ModelUnavailableError)
+from paddle_tpu.torrent import (KVStreamReceiver, KVStreamSender,
+                                build_records)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SIG_KW = dict(max_slots=4, block_size=4, max_context=32,
+              prefill_rows=(1, 2), prefill_seq_rungs=(8, 16))
+
+PROMPTS = [[3, 1, 4, 1, 5], [2, 7, 1], [9, 9, 8, 2, 6, 5, 3],
+           [1], [5, 5, 5, 5], [8, 6, 7, 5, 3, 0, 9]]
+
+
+@pytest.fixture(scope="module")
+def lm_fp_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tlm_fp") / "model")
+    tiny_lm.save_tiny_lm(d, **SIG_KW)
+    return d
+
+
+@pytest.fixture(scope="module")
+def lm_q8_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tlm_q8") / "model")
+    tiny_lm.save_tiny_lm(d, kv_dtype="int8", **SIG_KW)
+    return d
+
+
+@pytest.fixture
+def router():
+    r = fleet.FleetRouter(fleet.RouterConfig(
+        lease_s=1.0, poll_interval_s=0.15)).start()
+    yield r
+    r.close()
+
+
+def _member(router, rid, role="both", depth=0, inflight=0):
+    """Manufacture a ready member (no socket): the pick/affinity logic
+    under test is pure router state."""
+    router._register(rid, f"127.0.0.1:{9000 + len(router._members)}",
+                     None, session=None, lease_s=30.0, role=role)
+    m = router._members[rid]
+    m.ready = True
+    m.models = {"m": {"depth": depth, "warmed": True,
+                      "version_key": "k"}}
+    m.inflight = inflight
+    return m
+
+
+# ---------------------------------------------------------------------------
+# session affinity: pin lifecycle + role-filtered picking
+# ---------------------------------------------------------------------------
+
+class TestAffinity:
+    def test_pin_release_lifecycle_and_gauge(self, router):
+        _member(router, "d0", role="decode")
+        _member(router, "d1", role="decode")
+        reg = observe.metrics.default_registry()
+        m = router.pin_session("s1", "m")
+        assert m.replica_id in ("d0", "d1")
+        assert router.session_replica("s1") == m.replica_id
+        assert reg.get("fleet_affinity_sessions").value() == 1.0
+        assert router.release_session("s1", "eos") is True
+        assert router.session_replica("s1") is None
+        assert reg.get("fleet_affinity_sessions").value() == 0.0
+        assert reg.get("fleet_affinity_released_total").value(
+            model="m", reason="eos") == 1
+        # idempotent: a second release is a no-op, not a double count
+        assert router.release_session("s1", "eos") is False
+        assert reg.get("fleet_affinity_released_total").value(
+            model="m", reason="eos") == 1
+
+    def test_pin_only_lands_on_decode_pool(self, router):
+        _member(router, "p0", role="prefill")
+        _member(router, "b0", role="both")
+        m = router.pin_session("s1", "m")
+        assert m.replica_id == "b0"    # "both" qualifies, prefill never
+        router.release_session("s1", "cancel")
+        observe.metrics.default_registry()
+        # with ONLY prefill members there is nothing to pin
+        router._members.pop("b0").close()
+        with pytest.raises(ModelUnavailableError):
+            router.pin_session("s2", "m")
+
+    def test_pin_excludes_bad_decodes(self, router):
+        _member(router, "d0", role="decode")
+        _member(router, "d1", role="decode")
+        m = router.pin_session("s1", "m", exclude=frozenset({"d0"}))
+        assert m.replica_id == "d1"
+        router.release_session("s1", "cancel")
+
+    def test_replica_death_releases_its_pins(self, router):
+        _member(router, "d0", role="decode")
+        _member(router, "d1", role="decode")
+        pins = {sid: router.pin_session(sid, "m").replica_id
+                for sid in ("s1", "s2", "s3")}
+        victim = pins["s1"]
+        router.remove_replica(victim)
+        reg = observe.metrics.default_registry()
+        for sid, rid in pins.items():
+            if rid == victim:
+                assert router.session_replica(sid) is None
+            else:
+                assert router.session_replica(sid) == rid
+        dead = sum(1 for rid in pins.values() if rid == victim)
+        assert reg.get("fleet_affinity_released_total").value(
+            model="m", reason="death") == dead
+
+    def test_prefill_pool_stays_least_loaded(self, router):
+        _member(router, "p0", role="prefill", depth=5)
+        _member(router, "p1", role="prefill")
+        _member(router, "p2", role="both")
+        _member(router, "d0", role="decode")
+        picks = {router._pick("m", set(), role="prefill").replica_id
+                 for _ in range(8)}
+        # least-loaded tie between p1/p2; deep p0 and decode-only d0
+        # never take prefill traffic
+        assert picks == {"p1", "p2"}
+        assert router._pick("m", {"p1", "p2"},
+                            role="prefill").replica_id == "p0"
+
+    def test_role_rides_membership_doc(self, router):
+        _member(router, "p0", role="prefill")
+        assert router.members()["p0"]["role"] == "prefill"
+
+
+# ---------------------------------------------------------------------------
+# KV wire stream: round-trip, resume, supersede
+# ---------------------------------------------------------------------------
+
+def _fake_kv(kv_dtype="fp32", n_blocks=3, seed=0):
+    """A payload in serve/decode.py _extract_kv's shape (rows of
+    [block_size, heads, head_dim] per cache var)."""
+    r = np.random.RandomState(seed)
+    shape = (n_blocks, 4, 2, 8)
+    kv = {"prompt_len": 9, "n_blocks": n_blocks, "kv_dtype": kv_dtype}
+    if kv_dtype == "int8":
+        kv["cache"] = {c: r.randint(-127, 128, shape).astype(np.int8)
+                       for c in ("cache_k", "cache_v")}
+        kv["scales"] = {c: (r.rand(n_blocks) + 0.01).astype(np.float32)
+                        for c in ("cache_k", "cache_v")}
+    else:
+        kv["cache"] = {c: r.randn(*shape).astype(np.float32)
+                       for c in ("cache_k", "cache_v")}
+    return kv
+
+
+def _recorder_admit(admitted):
+    def admit(model, prompt, first_token, kv, max_new, trace):
+        fut = Future()
+        fut.set_result({"model": model, "prompt": prompt,
+                        "first_token": first_token, "kv": kv,
+                        "max_new": max_new, "trace": trace})
+        admitted.append(fut.result())
+        return fut
+    return admit
+
+
+class _FlakySend:
+    """send() that raises a transport error on chosen call numbers."""
+
+    def __init__(self, recv, fail_at=()):
+        self.recv = recv
+        self.fail_at = set(fail_at)
+        self.calls = 0
+
+    def __call__(self, records):
+        self.calls += 1
+        if self.calls in self.fail_at:
+            raise ConnectionResetError("torn mid-batch")
+        return int(self.recv.handle(records)["acked"])
+
+
+class TestKVStream:
+    @pytest.mark.parametrize("kv_dtype", ["fp32", "int8"])
+    def test_round_trip_both_residencies(self, kv_dtype):
+        kv = _fake_kv(kv_dtype)
+        admitted = []
+        recv = KVStreamReceiver(_recorder_admit(admitted))
+        sender = KVStreamSender("m", "s1", [1, 2, 3], 7, 10, kv)
+        sender.pump(lambda recs: int(recv.handle(recs)["acked"]),
+                    max_records=4)
+        assert sender.done and sender.bytes_sent > 0
+        (got,) = admitted
+        assert got["first_token"] == 7 and got["max_new"] == 10
+        out = got["kv"]
+        assert out["kv_dtype"] == kv_dtype
+        assert out["n_blocks"] == kv["n_blocks"]
+        for c, want in kv["cache"].items():
+            if kv_dtype == "int8":
+                # int8 residency ships raw values + scales VERBATIM
+                np.testing.assert_array_equal(out["cache"][c], want)
+                np.testing.assert_array_equal(out["scales"][c],
+                                              kv["scales"][c])
+            else:
+                # fp32 rides the lossy int8 wire codec: bounded error
+                tol = float(np.abs(want).max()) / 100.0
+                np.testing.assert_allclose(out["cache"][c], want,
+                                           atol=tol)
+        assert recv.future("s1").done()
+        recv.release("s1")
+        with pytest.raises(KVTransferError):
+            recv.future("s1")
+        assert recv.stats() == {"staging": 0, "futures": 0}
+
+    def test_torn_transfer_resumes_from_acked_watermark(self):
+        admitted = []
+        recv = KVStreamReceiver(_recorder_admit(admitted))
+        sender = KVStreamSender("m", "s1", [1, 2], 7, 10, _fake_kv())
+        send = _FlakySend(recv, fail_at=(2, 4))
+        sender.pump(send, max_records=2)
+        assert sender.done and sender.resumes == 2
+        assert len(admitted) == 1       # dedup: applied exactly once
+        reg = observe.metrics.default_registry()
+        assert reg.get("torrent_kv_stream_resumes_total").value(
+            model="m") >= 2
+
+    def test_sender_gives_up_with_kv_transfer_error(self):
+        recv = KVStreamReceiver(_recorder_admit([]))
+
+        def dead_send(records):
+            raise ConnectionResetError("receiver gone")
+
+        sender = KVStreamSender("m", "s1", [1], 7, 10, _fake_kv())
+        with pytest.raises(KVTransferError):
+            sender.pump(dead_send, max_retries=2)
+        assert not sender.done
+
+    def test_supersede_same_seq_new_nonce_wins(self):
+        admitted = []
+        recv = KVStreamReceiver(_recorder_admit(admitted))
+        s1 = KVStreamSender("m", "s1", [1, 2], 7, 10, _fake_kv(seed=1))
+        s1.pump(lambda r: int(recv.handle(r)["acked"]))
+        # re-prefill of the SAME sequence (decode failover): fresh
+        # nonce supersedes the committed staging
+        s2 = KVStreamSender("m", "s1", [1, 2], 7, 10, _fake_kv(seed=2))
+        s2.pump(lambda r: int(recv.handle(r)["acked"]))
+        assert len(admitted) == 2
+        assert recv.stats()["futures"] == 1
+        # stale-nonce records now have no staging: the old prefill's
+        # retry gets the re-prefill cue, not silent corruption
+        cmd, payload = build_records("m", "s1", s1.nonce, [1, 2], 7, 10,
+                                     _fake_kv(seed=1))[1]
+        with pytest.raises(KVTransferError):
+            recv.handle([(2, cmd, payload)])
+
+
+# ---------------------------------------------------------------------------
+# int8 KV residency: parity + capacity
+# ---------------------------------------------------------------------------
+
+class TestInt8Residency:
+    def test_int8_kv_matches_fp32_token_for_token(self, lm_fp_dir,
+                                                  lm_q8_dir):
+        sfp = serve.InferenceServer(fluid.CPUPlace(), serve.ServeConfig())
+        sq8 = serve.InferenceServer(fluid.CPUPlace(), serve.ServeConfig())
+        sfp.add_model("m", lm_fp_dir)
+        sq8.add_model("m", lm_q8_dir)
+        try:
+            for p in PROMPTS:
+                a = sfp.generate("m", p, max_new_tokens=12)
+                b = sq8.generate("m", p, max_new_tokens=12)
+                assert a.tokens == b.tokens, p
+                assert a.finish_reason == b.finish_reason
+        finally:
+            sfp.close()
+            sq8.close()
+
+    def test_int8_admits_3x_sequences_at_fixed_budget(self):
+        fp = tiny_lm.default_signature(**SIG_KW)
+        q8 = tiny_lm.default_signature(kv_dtype="int8", **SIG_KW)
+        # 4 cache vars (2 layers x k,v): fp32 pays 256 B/block per var,
+        # int8 pays 64 int8 values + one f32 block scale = 68 B
+        assert serve.block_residency_nbytes(fp) == 4 * 256
+        assert serve.block_residency_nbytes(q8) == 4 * 68
+        budget = 64 * 1024
+        per_seq = fp["max_context"] // fp["block_size"]
+        fp_seqs = serve.blocks_for_budget(fp, budget) // per_seq
+        q8_seqs = serve.blocks_for_budget(q8, budget) // per_seq
+        assert fp_seqs > 0
+        assert q8_seqs >= 3 * fp_seqs, (q8_seqs, fp_seqs)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: disaggregated fleet reproduces solo tokens, one trace
+# ---------------------------------------------------------------------------
+
+def _mk_lm_replica(mdir, router, rid, role):
+    srv = serve.InferenceServer(fluid.CPUPlace(), serve.ServeConfig())
+    srv.add_model("m", mdir)
+    rep = fleet.ReplicaServer(srv, replica_id=rid,
+                              router_endpoint=router.control_endpoint,
+                              lease_s=1.0, role=role).start()
+    return rep
+
+
+def _wait_ready(router, n, timeout=30):
+    deadline = time.time() + timeout
+    while len(router.ready_members("m")) < n:
+        assert time.time() < deadline, \
+            f"fleet never reached {n} ready: {router.members()}"
+        time.sleep(0.05)
+
+
+class TestDisaggregatedE2E:
+    def test_tokens_match_solo_and_pins_drain(self, lm_q8_dir, router):
+        solo = serve.InferenceServer(fluid.CPUPlace(), serve.ServeConfig())
+        solo.add_model("m", lm_q8_dir)
+        ref = [solo.generate("m", p, max_new_tokens=10).tokens
+               for p in PROMPTS]
+        solo.close()
+
+        reps = [_mk_lm_replica(lm_q8_dir, router, rid, role)
+                for rid, role in (("p0", "prefill"), ("d0", "decode"),
+                                  ("d1", "decode"))]
+        try:
+            _wait_ready(router, 3)
+            reg = observe.metrics.default_registry()
+            got = []
+            for p in PROMPTS:
+                r = router.generate_torrent("m", p, max_new_tokens=10)
+                got.append(r.tokens)
+                # the decode half served it; the prefill summary rides
+                # along (bytes shipped, stream nonce)
+                assert r.replica_id in ("d0", "d1")
+                assert r.outs["prefill"]["bytes"] > 0
+                assert r.outs["finish_reason"] in ("eos", "length")
+            assert got == ref
+            assert reg.get("torrent_kv_transfer_bytes_total").total() > 0
+            assert reg.get("torrent_generations_total").value(
+                model="m", outcome="ok") == len(PROMPTS)
+            # every pin released (EOS/length), none leaked
+            assert reg.get("fleet_affinity_sessions").value() == 0.0
+            assert reg.get("fleet_affinity_released_total").total() \
+                >= len(PROMPTS)
+        finally:
+            for rep in reps:
+                rep.close()
+
+    def test_cancel_releases_pin_and_receiver_staging(self, lm_q8_dir,
+                                                      router):
+        reps = [_mk_lm_replica(lm_q8_dir, router, rid, role)
+                for rid, role in (("p0", "prefill"), ("d0", "decode"))]
+        try:
+            _wait_ready(router, 2)
+            m = router.pin_session("cx", "m")
+            assert m.replica_id == "d0"
+            assert router.cancel_torrent("cx") is True
+            assert router.session_replica("cx") is None
+            assert router.cancel_torrent("cx") is False
+        finally:
+            for rep in reps:
+                rep.close()
+
+    def test_generation_is_one_stitched_trace(self, lm_q8_dir, router):
+        fluid.set_flag("observe", True)
+        observe.get_tracer().clear()
+        reps = [_mk_lm_replica(lm_q8_dir, router, rid, role)
+                for rid, role in (("p0", "prefill"), ("d0", "decode"))]
+        try:
+            _wait_ready(router, 2)
+            with xray.span("client_generate", cat="t") as root:
+                r = router.generate_torrent("m", PROMPTS[0],
+                                            max_new_tokens=6)
+            assert r.tokens
+        finally:
+            for rep in reps:
+                rep.close()
+            fluid.set_flag("observe", False)
+        names = {e.name for e in observe.get_tracer().events()
+                 if e.args.get("trace_id") == root.trace_id}
+        # the whole disaggregated generation is ONE trace: the routed
+        # prefill half, the prefill driver, the KV-stream hop INTO the
+        # decode replica, and the pinned collect
+        for must in ("fleet:torrent_generate", "replica:torrent_prefill",
+                     "torrent:prefill", "replica:torrent_kv",
+                     "replica:torrent_collect"):
+            assert must in names, (must, sorted(names))
+
+
+# ---------------------------------------------------------------------------
+# slow CI wrapper: the decode-kill drill, 3/3 seeds
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_decode_kill_drill_three_seeds(tmp_path):
+    """fluid-torrent CI gate: SIGKILL a decode replica mid-generation —
+    every pinned sequence fails over via re-prefill, finished outputs
+    are token-identical to the no-fault reference (zero lost completed
+    tokens), failovers metered — 3/3 seeds (the drill asserts the
+    details; see tools/chaos_drill.py)."""
+    import subprocess
+    import sys
+    for seed in (5, 6, 7):
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "chaos_drill.py"),
+             "--scenario", "decode_kill", "--seed", str(seed),
+             "--workdir", str(tmp_path / f"decode_kill_{seed}")],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, (seed, proc.stdout[-2000:],
+                                      proc.stderr[-2000:])

@@ -42,6 +42,15 @@ Scenarios (all seed-deterministic through ark.chaos):
                   failed requests (failovers metered; p99 degrades and
                   is recorded), the dead replica's lease expires, and
                   the survivors show zero steady-state recompiles
+    decode_kill   fluid-torrent: one of two DECODE replica processes of
+                  a disaggregated (1 prefill + 2 decode) fleet is
+                  SIGKILLed under concurrent generative traffic; PASS =
+                  every generation completes and is TOKEN-IDENTICAL to
+                  the solo no-fault reference (pinned sequences fail
+                  over via re-prefill; greedy decoding is deterministic
+                  so zero completed tokens are lost), torrent failovers
+                  metered, every session pin released, and the dead
+                  replica's lease expires
     ps_primary_kill  fluid-haven: SIGKILL the PRIMARY of a replicated
                   pserver pair mid-training, under async AND sync PS;
                   PASS = training completes with zero trainer-visible
@@ -738,6 +747,144 @@ def drill_replica_kill(seed, workdir, trace_out=None):
         print(f"  p99 {out['fleet_p99_pre_kill_us']:.0f} us pre-kill -> "
               f"{out['fleet_p99_post_kill_us']:.0f} us post-kill "
               f"(degraded, never failed)")
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.terminate()
+        for w in workers:
+            try:
+                w.wait(timeout=10)
+            except Exception:
+                w.kill()
+        router.close()
+        fluid.set_flag("observe", False)
+
+
+def drill_decode_kill(seed, workdir, trace_out=None):
+    """fluid-torrent: SIGKILL a decode replica of a disaggregated fleet
+    mid-generation (see module docstring)."""
+    import json
+    import random
+    import signal
+    import threading
+
+    from paddle_tpu import fleet, serve
+    from paddle_tpu.models import tiny_lm
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from fleet_router import spawn_replicas
+
+    fluid.set_flag("observe", True)
+    obs_metrics.default_registry().reset()
+    mdir = os.path.join(workdir, "model")
+    tiny_lm.save_tiny_lm(mdir, kv_dtype="int8", max_slots=4,
+                         block_size=4, max_context=32,
+                         prefill_rows=(1, 2), prefill_seq_rungs=(8, 16))
+
+    rng = random.Random(seed)
+    prompts = [[rng.randrange(32) for _ in range(rng.randint(1, 7))]
+               for _ in range(10)]
+    MAX_NEW = 10
+
+    # solo no-fault reference: the token sequences every disaggregated
+    # generation must reproduce EXACTLY, kill or no kill
+    solo = serve.InferenceServer(fluid.CPUPlace(), serve.ServeConfig())
+    solo.add_model("m", mdir)
+    ref = {i: solo.generate("m", p, max_new_tokens=MAX_NEW).tokens
+           for i, p in enumerate(prompts)}
+    solo.close()
+    print(f"  solo reference computed ({len(ref)} prompts)")
+
+    router = fleet.FleetRouter(fleet.RouterConfig(
+        lease_s=1.0, poll_interval_s=0.5)).start()
+    workers = []
+    try:
+        # 1 prefill + 2 decode; the decode pool simulates memory-bound
+        # device time per step so generations are in flight long enough
+        # for the SIGKILL to land mid-decode
+        workers += spawn_replicas(
+            1, mdir, router.control_endpoint, rid_prefix="p",
+            lease_s=1.0, extra_args=("--role", "prefill"))
+        workers += spawn_replicas(
+            2, mdir, router.control_endpoint, rid_prefix="d",
+            lease_s=1.0, extra_args=("--role", "decode",
+                                     "--sim-decode-step-us", "20000"))
+        deadline = time.time() + 120
+        while len(router.ready_members("m")) < 3:
+            if time.time() > deadline:
+                raise DrillFailure("fleet never became ready")
+            time.sleep(0.1)
+        print("  1 prefill + 2 decode replica processes ready")
+
+        DURATION, THREADS = 8.0, 4
+        stop = threading.Event()
+        lock = threading.Lock()
+        results, failures = [], []   # (prompt_idx, tokens), repr(e)
+        kill_at = [None]
+
+        def client(tid):
+            r = random.Random(seed * 100 + tid)
+            while not stop.is_set():
+                i = r.randrange(len(prompts))
+                try:
+                    res = router.generate_torrent(
+                        "m", prompts[i], max_new_tokens=MAX_NEW)
+                except Exception as e:      # noqa: BLE001
+                    with lock:
+                        failures.append(repr(e))
+                    continue
+                with lock:
+                    results.append((i, res.tokens,
+                                    kill_at[0] is not None))
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(THREADS)]
+        for t in threads:
+            t.start()
+        time.sleep(DURATION / 2)
+        victim = workers[1]          # first decode replica (d0)
+        kill_at[0] = time.perf_counter()
+        victim.send_signal(signal.SIGKILL)
+        victim.wait(timeout=10)
+        print("  SIGKILL'd decode replica d0 mid-generation")
+        time.sleep(DURATION / 2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+
+        post = [x for x in results if x[2]]
+        _check(not failures,
+               f"every generation completed across the kill "
+               f"({len(results)} ok, first failure: "
+               f"{failures[0] if failures else None})")
+        _check(len(post) > 0,
+               f"traffic kept flowing after the kill ({len(post)} "
+               f"post-kill generations)")
+        bad = [(i, toks) for i, toks, _ in results if toks != ref[i]]
+        _check(not bad,
+               f"zero lost completed tokens: all {len(results)} "
+               f"generations token-identical to the solo reference "
+               f"(first divergence: {bad[0] if bad else None})")
+        reg = obs_metrics.default_registry()
+        fo = reg.get("torrent_failovers_total")
+        _check(fo is not None and fo.total() >= 1,
+               f"torrent failovers metered "
+               f"({fo.total() if fo else 0:.0f})")
+        pins = reg.get("fleet_affinity_sessions")
+        _check(pins is not None and pins.value() == 0.0,
+               "every session pin released")
+        time.sleep(2.5)   # > 2 lease periods
+        mem = router.members()
+        _check("d0" not in mem or not mem["d0"]["lease_live"],
+               "dead decode replica's membership lease expired")
+
+        out = {
+            "decode_kill_failed": len(failures),
+            "decode_kill_generations_ok": len(results),
+            "decode_kill_post_kill_ok": len(post),
+            "decode_kill_failovers": fo.total() if fo else 0,
+            "decode_kill_divergent": len(bad),
+        }
+        print(json.dumps(out))
     finally:
         for w in workers:
             if w.poll() is None:
@@ -1763,6 +1910,7 @@ SCENARIOS = {
     "ps_handover": drill_ps_handover,
     "ps_partition": drill_ps_partition,
     "replica_kill": drill_replica_kill,
+    "decode_kill": drill_decode_kill,
     "quant_flaky_rpc": drill_quant_flaky_rpc,
     "pserver_kill": drill_pserver_kill,
     "ckpt_crash": drill_ckpt_crash,

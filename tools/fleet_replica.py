@@ -66,6 +66,17 @@ def main(argv=None):
     ap.add_argument("--device-ms", type=float, default=0.0,
                     help="REHEARSAL ONLY: simulated per-request device "
                     "time (sleep) — see ReplicaServer docstring")
+    ap.add_argument("--role", default="both",
+                    choices=("prefill", "decode", "both"),
+                    help="fluid-torrent pool this replica advertises "
+                    "(routing hint; 'both' = all traffic)")
+    ap.add_argument("--sim-prefill-us-per-token", type=float, default=0.0,
+                    help="REHEARSAL ONLY: simulated per-token prefill "
+                    "device time (sleep inside the engine loop) — "
+                    "models the compute-bound prefill phase on CPU rigs")
+    ap.add_argument("--sim-decode-step-us", type=float, default=0.0,
+                    help="REHEARSAL ONLY: simulated per-step decode "
+                    "device time — models the memory-bound decode phase")
     ap.add_argument("--trace-out", default=None, metavar="PATH",
                     help="turn the observe flag on and export this "
                     "process's chrome trace here at clean shutdown "
@@ -89,15 +100,24 @@ def main(argv=None):
         serve.ServeConfig(batch_timeout_ms=args.batch_timeout_ms,
                           max_queue=args.max_queue,
                           watch_interval_s=args.watch_interval_s or 2.0,
-                          pulse_port=args.pulse_port))
+                          pulse_port=args.pulse_port,
+                          simulate_prefill_us_per_token=(
+                              args.sim_prefill_us_per_token),
+                          simulate_decode_step_us=args.sim_decode_step_us))
     sparse = None
     if args.sparse_endpoints:
         sparse = fleet.SparseServeConfig(
             [e for e in args.sparse_endpoints.split(",") if e],
             comm_quant=args.sparse_quant,
             cache_rows=args.sparse_cache_rows)
-    ladder = serve.BucketLadder(
-        rows=tuple(int(b) for b in args.buckets.split(",")))
+    # generative dirs (a __decode__ sidecar in the manifest) derive
+    # their ladder from the decode signature; an explicit rows ladder
+    # is the dense one-shot path's knob only
+    from paddle_tpu.serve.registry import read_decode_signature
+    ladder = None
+    if read_decode_signature(args.model_dir) is None:
+        ladder = serve.BucketLadder(
+            rows=tuple(int(b) for b in args.buckets.split(",")))
     srv.add_model(args.name, args.model_dir, ladder=ladder, sparse=sparse)
     if args.watch_interval_s > 0:
         srv.start_watch(args.watch_interval_s)
@@ -105,7 +125,8 @@ def main(argv=None):
     rep = fleet.ReplicaServer(srv, endpoint=args.endpoint, replica_id=rid,
                               router_endpoint=args.router,
                               lease_s=args.lease_s,
-                              simulate_device_ms=args.device_ms).start()
+                              simulate_device_ms=args.device_ms,
+                              role=args.role).start()
     print(f"REPLICA {rep.endpoint}", flush=True)
     if srv.pulse_port is not None:
         print(f"PULSE {srv.pulse_port}", flush=True)

@@ -34,9 +34,14 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
 def spawn_replicas(n, model_dir, router_ep, extra_args=(), name="m",
-                   pulse=False, device_ms=0.0, lease_s=3.0):
+                   pulse=False, device_ms=0.0, lease_s=3.0,
+                   rid_prefix="r"):
     """Start n `tools/fleet_replica.py` subprocesses against `router_ep`;
-    returns the Popen list after every worker printed READY."""
+    returns the Popen list after every worker printed READY.
+
+    Mixed fluid-torrent pools: call twice with distinct `rid_prefix`es
+    and `extra_args=("--role", "prefill")` / `("--role", "decode")` —
+    replica ids must not collide across the calls."""
     workers = []
     tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "fleet_replica.py")
@@ -44,7 +49,8 @@ def spawn_replicas(n, model_dir, router_ep, extra_args=(), name="m",
     for i in range(n):
         cmd = [sys.executable, tool, "--model-dir", model_dir,
                "--name", name, "--router", router_ep,
-               "--replica-id", f"r{i}", "--lease-s", str(lease_s)]
+               "--replica-id", f"{rid_prefix}{i}",
+               "--lease-s", str(lease_s)]
         if pulse:
             cmd += ["--pulse-port", "0"]
         if device_ms:
